@@ -1,0 +1,24 @@
+"""charon_tpu — a TPU-native Ethereum distributed-validator framework.
+
+A ground-up re-design of the capabilities of Charon (Obol's DV middleware,
+reference: docs/architecture.md:5-47): n nodes jointly operate m validators
+via a duty pipeline (scheduler → fetcher → consensus → dutydb → validator
+API → parsig db/exchange → threshold aggregation → broadcast) with t-of-n
+BLS12-381 threshold signatures.  Unlike the Go/CPU reference, the crypto
+hot path — batched pairing verification and Lagrange-weighted G2
+interpolation — runs as batched JAX/Pallas kernels on TPU.
+
+Package map (SURVEY.md §2 inventory → here):
+  tbls/      threshold BLS scheme, pluggable CPU-reference + TPU backends
+  ops/       batched BLS12-381 field/curve/pairing kernels (jnp + pallas)
+  parallel/  device-mesh sharding of the crypto batch dimension
+  core/      the duty workflow (types, wiring, scheduler … bcast, qbft)
+  p2p/       cluster transport (asyncio mesh, in-memory test transport)
+  dkg/       distributed key generation (keycast + FROST)
+  cluster/   cluster definition / lock formats
+  eth2util/  signing domains, deposits, keystores
+  app/       wiring + lifecycle + infra (log, retry, featureset, metrics)
+  testutil/  beaconmock, validatormock, simnet helpers
+"""
+
+__version__ = "0.1.0"

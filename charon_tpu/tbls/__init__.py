@@ -1,0 +1,7 @@
+"""Threshold BLS12-381 signature scheme with pluggable backends.
+
+Mirrors the reference tbls package API surface (reference: tbls/tss.go:120-290):
+GenerateTSS / SplitSecret / CombineShares / PartialSign / Sign / Verify /
+Aggregate / VerifyAndAggregate — with a CPU reference backend and a batched
+TPU (JAX) backend selected at runtime.
+"""

@@ -1,0 +1,9 @@
+"""Pure-Python BLS12-381 reference (correctness oracle + CPU cold path)."""
+
+from .fields import FQ, FQ2, FQ12, P, R  # noqa: F401
+from .curve import (  # noqa: F401
+    B1, B2, G1_GEN, G2_GEN, H1, H2, add, double, multiply, neg,
+    is_on_curve, in_g1, in_g2, clear_cofactor_g1, clear_cofactor_g2,
+    g1_to_bytes, g1_from_bytes, g2_to_bytes, g2_from_bytes,
+)
+from .pairing import pairing, miller_loop, final_exponentiate, multi_pairing_is_one  # noqa: F401

@@ -1,0 +1,15 @@
+"""Test configuration.
+
+All tests run on CPU with 8 virtual XLA devices so multi-chip sharding
+(mesh/pjit paths) is exercised without TPU hardware, mirroring how the
+reference tests everything in-process (reference: app/simnet_test.go:57).
+Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

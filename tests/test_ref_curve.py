@@ -1,0 +1,123 @@
+"""Curve-group checks: generators, orders, cofactors, serialisation."""
+
+import random
+
+import pytest
+
+from charon_tpu.tbls.ref import curve as c
+from charon_tpu.tbls.ref.fields import FQ, FQ2, P, R
+
+rng = random.Random(0xC0FE)
+
+
+def test_generators_on_curve():
+    assert c.is_on_curve(c.G1_GEN, c.B1)
+    assert c.is_on_curve(c.G2_GEN, c.B2)
+
+
+def test_generator_orders():
+    assert c.multiply_raw(c.G1_GEN, R) is None
+    assert c.multiply_raw(c.G2_GEN, R) is None
+    assert c.multiply(c.G1_GEN, 1) == c.G1_GEN
+
+
+def test_group_law():
+    g = c.G1_GEN
+    assert c.add(g, c.neg(g)) is None
+    assert c.add(c.add(g, g), g) == c.multiply(g, 3)
+    assert c.double(g) == c.add(g, g)
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    assert c.add(c.multiply(g, a), c.multiply(g, b)) == c.multiply(g, a + b)
+    g2 = c.G2_GEN
+    assert c.add(c.multiply(g2, a), c.multiply(g2, b)) == c.multiply(g2, a + b)
+
+
+def test_g2_cofactor_derivation():
+    # H2 * R must equal a valid twist order: a random curve point cleared by
+    # H2 lands in the R-torsion.
+    pt = _random_g2_curve_point()
+    cleared = c.clear_cofactor_g2(pt)
+    assert cleared is not None
+    assert c.multiply_raw(cleared, R) is None
+
+
+def test_g1_cofactor():
+    pt = _random_g1_curve_point()
+    cleared = c.clear_cofactor_g1(pt)
+    assert cleared is not None
+    assert c.multiply_raw(cleared, R) is None
+
+
+def _random_g1_curve_point():
+    while True:
+        x = FQ(rng.randrange(P))
+        y = (x * x * x + c.B1).sqrt()
+        if y is not None:
+            return (x, y)
+
+
+def _random_g2_curve_point():
+    while True:
+        x = FQ2([rng.randrange(P), rng.randrange(P)])
+        y = (x * x * x + c.B2).sqrt()
+        if y is not None:
+            return (x, y)
+
+
+def test_g1_serialisation_roundtrip():
+    for k in [1, 2, 12345, R - 1]:
+        pt = c.multiply(c.G1_GEN, k)
+        data = c.g1_to_bytes(pt)
+        assert len(data) == 48
+        assert c.g1_from_bytes(data) == pt
+    assert c.g1_from_bytes(c.g1_to_bytes(None)) is None
+
+
+def test_g2_serialisation_roundtrip():
+    for k in [1, 7, 999999]:
+        pt = c.multiply(c.G2_GEN, k)
+        data = c.g2_to_bytes(pt)
+        assert len(data) == 96
+        assert c.g2_from_bytes(data) == pt
+    assert c.g2_from_bytes(c.g2_to_bytes(None)) is None
+
+
+def test_g1_generator_known_encoding():
+    # The canonical compressed encoding of the G1 generator (well-known constant).
+    enc = c.g1_to_bytes(c.G1_GEN)
+    assert enc.hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+
+
+def test_g2_generator_known_encoding():
+    # Canonical compressed encoding of the G2 generator: c1 serialised first
+    # (pins the ZCash byte order against a well-known constant).
+    enc = c.g2_to_bytes(c.G2_GEN)
+    assert enc.hex() == (
+        "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+        "334cf11213945d57e5ac7d055d042b7e"
+        "024aa2b2f08f0a91260805272dc51051c6e47ad4fa403b02b4510b647ae3d177"
+        "0bac0326a805bbefd48056c8c121bdb8"
+    )
+
+
+def test_deserialise_rejects_bad_points():
+    with pytest.raises(ValueError):
+        c.g1_from_bytes(b"\x00" * 48)  # uncompressed flag unset
+    bad = bytearray(c.g1_to_bytes(c.G1_GEN))
+    bad[-1] ^= 1
+    with pytest.raises(ValueError):
+        c.g1_from_bytes(bytes(bad))
+
+
+def test_non_subgroup_point_rejected():
+    # A curve point NOT in G1 (not cleared by cofactor) must fail the check.
+    pt = _random_g1_curve_point()
+    if c.multiply_raw(pt, R) is None:
+        pt = c.add(pt, _random_g1_curve_point())  # extremely unlikely branch
+    data = c.g1_to_bytes(pt)
+    with pytest.raises(ValueError):
+        c.g1_from_bytes(data)
+    assert c.g1_from_bytes(data, subgroup_check=False) == pt

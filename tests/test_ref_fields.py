@@ -1,0 +1,95 @@
+"""Field-tower axioms for the pure-Python oracle."""
+
+import random
+
+from charon_tpu.tbls.ref.fields import FQ, FQ2, FQ12, P, fq2_to_fq12
+
+rng = random.Random(0xB15)
+
+
+def rand_fq():
+    return FQ(rng.randrange(P))
+
+
+def rand_fq2():
+    return FQ2([rng.randrange(P), rng.randrange(P)])
+
+
+def rand_fq12():
+    return FQ12([rng.randrange(P) for _ in range(12)])
+
+
+def test_fq_ring_axioms():
+    for _ in range(20):
+        a, b, c = rand_fq(), rand_fq(), rand_fq()
+        assert (a + b) + c == a + (b + c)
+        assert (a * b) * c == a * (b * c)
+        assert a * (b + c) == a * b + a * c
+        assert a - a == FQ.zero()
+        if not a.is_zero():
+            assert a * a.inv() == FQ.one()
+
+
+def test_fq_sqrt():
+    for _ in range(20):
+        a = rand_fq()
+        s = (a * a).sqrt()
+        assert s is not None and s * s == a * a
+
+
+def test_fq2_axioms_and_u():
+    u = FQ2([0, 1])
+    assert u * u == FQ2([P - 1, 0])  # u^2 = -1
+    for _ in range(20):
+        a, b = rand_fq2(), rand_fq2()
+        assert (a * b) * a == a * (b * a)
+        if not a.is_zero():
+            assert a * a.inv() == FQ2.one()
+        s = (a * a).sqrt()
+        assert s is not None and s * s == a * a
+
+
+def test_fq2_nonsquare_has_no_root():
+    # u+2 is a non-square in Fp2 for BLS12-381 (verified by construction here)
+    found_none = False
+    for k in range(2, 20):
+        cand = FQ2([k, 1])
+        if cand.sqrt() is None:
+            found_none = True
+            break
+    assert found_none
+
+
+def test_fq12_axioms():
+    for _ in range(5):
+        a, b, c = rand_fq12(), rand_fq12(), rand_fq12()
+        assert (a + b) * c == a * c + b * c
+        assert (a * b) * c == a * (b * c)
+        if not a.is_zero():
+            assert a * a.inv() == FQ12.one()
+
+
+def test_fq12_tower_structure():
+    # u = w^6 - 1 must satisfy u^2 = -1
+    w = FQ12([0, 1] + [0] * 10)
+    u = w**6 - FQ12.one()
+    assert u * u == FQ12([P - 1] + [0] * 11)
+    # the Fp2 embedding is a ring homomorphism
+    for _ in range(5):
+        a, b = rand_fq2(), rand_fq2()
+        assert fq2_to_fq12(a) * fq2_to_fq12(b) == fq2_to_fq12(a * b)
+        assert fq2_to_fq12(a) + fq2_to_fq12(b) == fq2_to_fq12(a + b)
+
+
+def test_conjugate_p6_is_frobenius_p6():
+    # x^(p^6) computed naively must equal the cheap coefficient-flip version
+    a = rand_fq12()
+    assert a.conjugate_p6() * a.conjugate_p6() == (a * a).conjugate_p6()
+    # and it must be an involution that fixes Fp2^... even powers
+    assert a.conjugate_p6().conjugate_p6() == a
+
+
+def test_fq2_frobenius():
+    for _ in range(5):
+        a = rand_fq2()
+        assert a.frobenius() == a**P

@@ -1,0 +1,66 @@
+"""Pairing self-validation: non-degeneracy + bilinearity.
+
+Bilinearity over random scalars validates the entire construction (field
+tower, twist/untwist, Miller loop, final exponentiation) without external
+test vectors.
+"""
+
+import random
+
+import pytest
+
+from charon_tpu.tbls.ref import curve as c
+from charon_tpu.tbls.ref.fields import FQ12, R
+from charon_tpu.tbls.ref.pairing import (final_exponentiate, miller_loop,
+                                         multi_pairing_is_one, pairing,
+                                         untwist, cast_g1)
+
+rng = random.Random(0xE1117)
+
+
+def test_untwist_lands_on_curve():
+    q = untwist(c.G2_GEN)
+    assert c.is_on_curve(q, c.B12)
+    q2 = untwist(c.multiply(c.G2_GEN, 5))
+    assert c.is_on_curve(q2, c.B12)
+    # untwist is a homomorphism: untwist(2Q) == 2·untwist(Q)
+    assert untwist(c.multiply(c.G2_GEN, 2)) == c.double(untwist(c.G2_GEN))
+
+
+@pytest.mark.slow
+def test_pairing_nondegenerate():
+    e = pairing(c.G2_GEN, c.G1_GEN)
+    assert e != FQ12.one()
+    assert e**R == FQ12.one()  # lands in the order-r subgroup of Fp12*
+
+
+@pytest.mark.slow
+def test_pairing_bilinear():
+    a = rng.randrange(2, 2**64)
+    b = rng.randrange(2, 2**64)
+    p_a = c.multiply(c.G1_GEN, a)
+    q_b = c.multiply(c.G2_GEN, b)
+    # one shared final exponentiation keeps this test fast:
+    # e(aP, Q) * e(P, Q)^-a == 1  via product-of-miller-loops
+    # e(aP, Q) · e(-P, aQ) == 1
+    ml1 = miller_loop(untwist(c.G2_GEN), cast_g1(p_a))
+    ml4 = miller_loop(untwist(c.multiply(c.G2_GEN, a)), cast_g1(c.neg(c.G1_GEN)))
+    assert final_exponentiate(ml1 * ml4) == FQ12.one()
+    # e(P, bQ) · e(-bP, Q) == 1
+    assert multi_pairing_is_one([
+        (c.G1_GEN, q_b),
+        (c.neg(c.multiply(c.G1_GEN, b)), c.G2_GEN),
+    ])
+    # e(aP, bQ) · e(-abP, Q) == 1
+    assert multi_pairing_is_one([
+        (p_a, q_b),
+        (c.neg(c.multiply(c.G1_GEN, (a * b) % R)), c.G2_GEN),
+    ])
+
+
+@pytest.mark.slow
+def test_multi_pairing_detects_mismatch():
+    assert not multi_pairing_is_one([
+        (c.G1_GEN, c.G2_GEN),
+        (c.neg(c.multiply(c.G1_GEN, 3)), c.G2_GEN),
+    ])
