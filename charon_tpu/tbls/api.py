@@ -1,0 +1,249 @@
+"""Public threshold-BLS API — the fixed interface the duty pipeline calls.
+
+Mirrors the reference tbls surface (reference: tbls/tss.go:120-290):
+GenerateTSS, SplitSecret, CombineShares, PartialSign, Sign, Verify,
+Aggregate, VerifyAndAggregate — plus the batch-first entry points the TPU
+backend accelerates (BatchVerify / ThresholdCombine), which the CPU
+reference backend implements as loops.
+
+Keys and signatures cross this boundary as canonical ZCash-format bytes
+(48-byte G1 pubkeys, 96-byte G2 signatures, 32-byte scalars), exactly like
+the reference's tblsconv layer (reference: tbls/tblsconv/tblsconv.go:29-173),
+so backends are free to choose internal representations (limb planes on
+TPU).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+
+from . import shamir
+from .ref import bls, curve
+from .ref.fields import R
+from .ref.hash_to_curve import DST_G2
+
+# ---------------------------------------------------------------------------
+# Wire types
+# ---------------------------------------------------------------------------
+
+PubKey = bytes      # 48-byte compressed G1
+Signature = bytes   # 96-byte compressed G2
+PrivKey = bytes     # 32-byte big-endian scalar
+
+
+def privkey_to_int(sk: PrivKey) -> int:
+    return int.from_bytes(sk, "big") % R
+
+
+def int_to_privkey(n: int) -> PrivKey:
+    return (n % R).to_bytes(32, "big")
+
+
+@dataclass(frozen=True)
+class TSS:
+    """Threshold signature scheme metadata: group key + per-share pubkeys
+    derived from Feldman commitments (reference: tbls/tss.go:62-116)."""
+
+    group_pubkey: PubKey
+    commitments: tuple[PubKey, ...]  # a_j·G1 for each polynomial coefficient
+    num_shares: int
+
+    @property
+    def threshold(self) -> int:
+        return len(self.commitments)
+
+    def public_share(self, idx: int) -> PubKey:
+        """Evaluate the commitment polynomial in the exponent at idx."""
+        if not 1 <= idx <= self.num_shares:
+            raise ValueError(f"share index {idx} out of range")
+        acc = None
+        x = 1
+        for c_bytes in self.commitments:
+            pt = curve.g1_from_bytes(c_bytes)
+            acc = curve.add(acc, curve.multiply(pt, x))
+            x = x * idx % R
+        return curve.g1_to_bytes(acc)
+
+    _share_cache: dict = field(default_factory=dict, compare=False, hash=False)
+
+    def public_shares(self) -> dict[int, PubKey]:
+        if not self._share_cache:
+            for i in range(1, self.num_shares + 1):
+                self._share_cache[i] = self.public_share(i)
+        return dict(self._share_cache)
+
+
+# ---------------------------------------------------------------------------
+# Scheme operations (CPU oracle path; TPU batch ops live in backend_tpu)
+# ---------------------------------------------------------------------------
+
+def generate_tss(threshold: int, num_shares: int,
+                 seed: bytes | None = None) -> tuple[TSS, dict[int, PrivKey]]:
+    """Trusted-dealer keygen: split a fresh secret t-of-n.
+    Reference: tbls/tss.go:120-139 GenerateTSS."""
+    import random
+
+    rng = random.Random(seed) if seed is not None else None
+    sk = bls.keygen(seed)
+    shares, coeffs = shamir.split_secret(sk, threshold, num_shares, rng)
+    commitments = tuple(
+        curve.g1_to_bytes(curve.multiply(curve.G1_GEN, a)) for a in coeffs
+    )
+    tss = TSS(group_pubkey=commitments[0], commitments=commitments,
+              num_shares=num_shares)
+    return tss, {i: int_to_privkey(s) for i, s in shares.items()}
+
+
+def split_secret(secret: PrivKey, threshold: int,
+                 num_shares: int) -> tuple[TSS, dict[int, PrivKey]]:
+    """Split an existing secret (reference: tbls/tss.go:220-270)."""
+    shares, coeffs = shamir.split_secret(privkey_to_int(secret), threshold,
+                                         num_shares)
+    commitments = tuple(
+        curve.g1_to_bytes(curve.multiply(curve.G1_GEN, a)) for a in coeffs
+    )
+    return (TSS(group_pubkey=commitments[0], commitments=commitments,
+                num_shares=num_shares),
+            {i: int_to_privkey(s) for i, s in shares.items()})
+
+
+def combine_shares(shares: dict[int, PrivKey]) -> PrivKey:
+    return int_to_privkey(
+        shamir.combine_shares({i: privkey_to_int(s) for i, s in shares.items()}))
+
+
+def generate_privkey() -> PrivKey:
+    return int_to_privkey(bls.keygen())
+
+
+def privkey_to_pubkey(sk: PrivKey) -> PubKey:
+    return curve.g1_to_bytes(bls.sk_to_pk(privkey_to_int(sk)))
+
+
+def sign(sk: PrivKey, msg: bytes) -> Signature:
+    return curve.g2_to_bytes(bls.sign(privkey_to_int(sk), msg))
+
+
+# PartialSign is just Sign with a share key; kept for reference-API parity
+# (reference: tbls/tss.go:190-198).
+partial_sign = sign
+
+
+def verify(pubkey: PubKey, msg: bytes, sig: Signature) -> bool:
+    try:
+        pk = curve.g1_from_bytes(pubkey)
+        s = curve.g2_from_bytes(sig)
+    except ValueError:
+        return False
+    return _backend().verify(pk, msg, s)
+
+
+def aggregate(partial_sigs: dict[int, Signature]) -> Signature:
+    """Lagrange-interpolate ≥t partial signatures into the group signature —
+    THE hot op (reference: tbls/tss.go:142-149, called from
+    core/sigagg/sigagg.go:75-77)."""
+    [out] = threshold_combine([partial_sigs])
+    return out
+
+
+def verify_and_aggregate(tss: TSS, partial_sigs: dict[int, Signature],
+                         msg: bytes) -> tuple[Signature, list[int]]:
+    """Verify each partial against its pubshare, then combine the valid ones.
+    Returns (group signature, participating share indices).
+    Reference: tbls/tss.go:153-187."""
+    if len(partial_sigs) < tss.threshold:
+        raise ValueError("insufficient partial signatures")
+    entries = [(tss.public_share(i), msg, s) for i, s in partial_sigs.items()]
+    oks = batch_verify(entries)
+    valid = {i: s for (i, s), ok in zip(partial_sigs.items(), oks) if ok}
+    if len(valid) < tss.threshold:
+        raise ValueError("insufficient valid partial signatures")
+    take = dict(list(valid.items())[: tss.threshold])
+    sig = aggregate(take)
+    if not verify(tss.group_pubkey, msg, sig):
+        raise ValueError("aggregated signature failed group verification")
+    return sig, sorted(take)
+
+
+# ---------------------------------------------------------------------------
+# Batch entry points (what the TPU backend accelerates)
+# ---------------------------------------------------------------------------
+
+def batch_verify(entries: list[tuple[PubKey, bytes, Signature]]) -> list[bool]:
+    """Verify a batch of (pubkey, msg, signature) triples."""
+    parsed = []
+    oks = [True] * len(entries)
+    for k, (pk_b, msg, sig_b) in enumerate(entries):
+        try:
+            parsed.append((curve.g1_from_bytes(pk_b), msg,
+                           curve.g2_from_bytes(sig_b)))
+        except ValueError:
+            oks[k] = False
+            parsed.append(None)
+    results = _backend().batch_verify([p for p in parsed if p is not None])
+    it = iter(results)
+    return [oks[k] and next(it) if parsed[k] is not None else False
+            for k in range(len(entries))]
+
+
+def threshold_combine(
+        batch: list[dict[int, Signature]]) -> list[Signature]:
+    """Lagrange-combine many validators' partial-signature sets at once —
+    the batched MSM the TPU kernels own."""
+    parsed = [
+        {i: curve.g2_from_bytes(s) for i, s in sigs.items()} for sigs in batch
+    ]
+    combined = _backend().threshold_combine(parsed)
+    return [curve.g2_to_bytes(pt) for pt in combined]
+
+
+# ---------------------------------------------------------------------------
+# Backend registry (north-star `--tbls-backend=tpu` switch)
+# ---------------------------------------------------------------------------
+
+class CPUBackend:
+    """Loop-based oracle backend."""
+
+    name = "cpu"
+
+    def verify(self, pk, msg: bytes, sig) -> bool:
+        return bls.verify(pk, msg, sig)
+
+    def batch_verify(self, entries) -> list[bool]:
+        return [bls.verify(pk, msg, sig) for pk, msg, sig in entries]
+
+    def threshold_combine(self, batch):
+        out = []
+        for sigs in batch:
+            lam = shamir.lagrange_coeffs_at_zero(list(sigs))
+            acc = None
+            for i, pt in sigs.items():
+                acc = curve.add(acc, curve.multiply(pt, lam[i]))
+            out.append(acc)
+        return out
+
+
+_BACKENDS: dict[str, object] = {"cpu": CPUBackend()}
+_current = _BACKENDS["cpu"]
+
+
+def register_backend(name: str, backend) -> None:
+    _BACKENDS[name] = backend
+
+
+def set_backend(name: str) -> None:
+    global _current
+    if name == "tpu" and "tpu" not in _BACKENDS:
+        from . import backend_tpu  # lazy: importing jax is expensive
+
+        register_backend("tpu", backend_tpu.TPUBackend())
+    _current = _BACKENDS[name]
+
+
+def _backend():
+    return _current
+
+
+def backend_name() -> str:
+    return _current.name

@@ -99,10 +99,6 @@ def multiply_raw(pt: Point, n: int) -> Point:
     return result
 
 
-def eq(p1: Point, p2: Point) -> bool:
-    return p1 == p2
-
-
 # ---------------------------------------------------------------------------
 # G2 cofactor — derived, not memorised.
 # ---------------------------------------------------------------------------

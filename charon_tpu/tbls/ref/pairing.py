@@ -18,7 +18,7 @@ behaviour is unaffected either way.
 from __future__ import annotations
 
 from .curve import Point, add, double
-from .fields import (FQ12, P, R, W2_INV, W3_INV, BLS_X,
+from .fields import (FQ2, FQ12, P, R, W2_INV, W3_INV, BLS_X,
                      BLS_X_IS_NEGATIVE, fq2_to_fq12)
 
 FINAL_EXP = (P**12 - 1) // R
@@ -78,8 +78,11 @@ def final_exponentiate(f: FQ12) -> FQ12:
     return f**FINAL_EXP
 
 
-def pairing(q: Point, p: Point, *, final_exp: bool = True) -> FQ12:
-    """e(P, Q) with P ∈ G1(E/Fp), Q ∈ G2(E'/Fp2)."""
+def pairing(p: Point, q: Point, *, final_exp: bool = True) -> FQ12:
+    """e(P, Q) with P ∈ G1(E/Fp), Q ∈ G2(E'/Fp2) — G1-first, matching the
+    (P_i, Q_i) pair order of multi_pairing_is_one."""
+    if q is not None and not isinstance(q[0], FQ2):
+        raise TypeError("pairing(p, q) takes the G1 point first, G2 second")
     f = miller_loop(untwist(q), cast_g1(p))
     return final_exponentiate(f) if final_exp else f
 

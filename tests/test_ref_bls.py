@@ -1,0 +1,137 @@
+"""Hash-to-curve, BLS scheme, and Shamir threshold tests."""
+
+import random
+
+import pytest
+
+from charon_tpu.tbls import api, shamir
+from charon_tpu.tbls.ref import bls, curve as c
+from charon_tpu.tbls.ref.fields import FQ2, P, R
+from charon_tpu.tbls.ref.hash_to_curve import (expand_message_xmd,
+                                               hash_to_field_fp2,
+                                               hash_to_g2,
+                                               map_to_curve_svdw, _Z)
+
+rng = random.Random(0x51)
+
+
+def test_expand_message_xmd_shape_and_determinism():
+    out = expand_message_xmd(b"abc", b"TEST-DST", 256)
+    assert len(out) == 256
+    assert out == expand_message_xmd(b"abc", b"TEST-DST", 256)
+    assert out != expand_message_xmd(b"abd", b"TEST-DST", 256)
+    assert out != expand_message_xmd(b"abc", b"TEST-DST2", 256)
+    assert expand_message_xmd(b"", b"D", 32) != expand_message_xmd(b"\x00", b"D", 32)
+
+
+def test_hash_to_field_in_range():
+    els = hash_to_field_fp2(b"msg", 2, b"DST")
+    assert len(els) == 2
+    for e in els:
+        assert all(0 <= co < P for co in e.coeffs)
+
+
+def test_svdw_map_on_curve():
+    for k in range(8):
+        u = FQ2([rng.randrange(P), rng.randrange(P)])
+        pt = map_to_curve_svdw(u)
+        assert c.is_on_curve(pt, c.B2)
+    # deterministic
+    u = FQ2([5, 7])
+    assert map_to_curve_svdw(u) == map_to_curve_svdw(u)
+    # Z itself maps fine (x3 branch edge case: u with tv1*tv2 == 0)
+    assert c.is_on_curve(map_to_curve_svdw(FQ2.zero()), c.B2)
+
+
+def test_hash_to_g2_subgroup_and_determinism():
+    p1 = hash_to_g2(b"hello")
+    p2 = hash_to_g2(b"hello")
+    p3 = hash_to_g2(b"world")
+    assert p1 == p2 != p3
+    assert c.in_g2(p1)
+    assert c.in_g2(p3)
+
+
+@pytest.mark.slow
+def test_sign_verify_roundtrip():
+    sk = bls.keygen(b"seed-1")
+    pk = bls.sk_to_pk(sk)
+    msg = b"attestation data root"
+    sig = bls.sign(sk, msg)
+    assert c.in_g2(sig)
+    assert bls.verify(pk, msg, sig)
+    assert not bls.verify(pk, b"other message", sig)
+    sk2 = bls.keygen(b"seed-2")
+    assert not bls.verify(bls.sk_to_pk(sk2), msg, sig)
+
+
+def test_shamir_split_combine():
+    secret = rng.randrange(1, R)
+    shares, coeffs = shamir.split_secret(secret, 3, 5, rng)
+    assert len(shares) == 5 and len(coeffs) == 3
+    assert shamir.combine_shares({i: shares[i] for i in (1, 3, 5)}) == secret
+    assert shamir.combine_shares({i: shares[i] for i in (2, 4, 5)}) == secret
+    assert shamir.combine_shares(shares) == secret  # more than t also works
+    # t-1 shares give the wrong secret (no information-theoretic test here,
+    # just that interpolation of too few points misses)
+    assert shamir.combine_shares({i: shares[i] for i in (1, 2)}) != secret
+
+
+def test_shamir_rejects_bad_params():
+    with pytest.raises(ValueError):
+        shamir.split_secret(1, 0, 5)
+    with pytest.raises(ValueError):
+        shamir.split_secret(1, 6, 5)
+    with pytest.raises(ValueError):
+        shamir.lagrange_coeffs_at_zero([1, 1, 2])
+
+
+def test_tss_public_shares_match_key_shares():
+    tss, shares = api.generate_tss(3, 4, seed=b"tss-seed")
+    assert tss.threshold == 3 and tss.num_shares == 4
+    for i, sk in shares.items():
+        assert api.privkey_to_pubkey(sk) == tss.public_share(i)
+    # group pubkey corresponds to the combined secret
+    secret = api.combine_shares({i: shares[i] for i in (1, 2, 4)})
+    assert api.privkey_to_pubkey(secret) == tss.group_pubkey
+
+
+@pytest.mark.slow
+def test_threshold_sign_aggregate_verify():
+    tss, shares = api.generate_tss(2, 3, seed=b"agg-seed")
+    msg = b"duty: attester slot 42"
+    psigs = {i: api.partial_sign(shares[i], msg) for i in (1, 3)}
+    group_sig = api.aggregate(psigs)
+    assert api.verify(tss.group_pubkey, msg, group_sig)
+    # aggregating a different pair of shares yields the SAME group signature
+    psigs2 = {i: api.partial_sign(shares[i], msg) for i in (2, 3)}
+    assert api.aggregate(psigs2) == group_sig
+
+
+@pytest.mark.slow
+def test_verify_and_aggregate_filters_bad_partial():
+    tss, shares = api.generate_tss(2, 3, seed=b"vaa-seed")
+    msg = b"duty: proposer slot 7"
+    psigs = {i: api.partial_sign(shares[i], msg) for i in (1, 2)}
+    sig, used = api.verify_and_aggregate(tss, psigs, msg)
+    assert used == [1, 2]
+    assert api.verify(tss.group_pubkey, msg, sig)
+    # one bad partial among three: still aggregates from the good two
+    bad = dict(psigs)
+    bad[3] = api.partial_sign(shares[3], b"WRONG MESSAGE")
+    sig2, used2 = api.verify_and_aggregate(tss, bad, msg)
+    assert 3 not in used2
+    assert api.verify(tss.group_pubkey, msg, sig2)
+    # all-bad raises
+    with pytest.raises(ValueError):
+        api.verify_and_aggregate(
+            tss, {1: bad[3], 2: bad[3]}, msg)
+
+
+@pytest.mark.slow
+def test_pop_prove_verify():
+    sk = bls.keygen(b"pop-seed")
+    proof = bls.pop_prove(sk)
+    assert bls.pop_verify(bls.sk_to_pk(sk), proof)
+    other = bls.keygen(b"pop-other")
+    assert not bls.pop_verify(bls.sk_to_pk(other), proof)

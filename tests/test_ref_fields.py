@@ -82,10 +82,10 @@ def test_fq12_tower_structure():
 
 
 def test_conjugate_p6_is_frobenius_p6():
-    # x^(p^6) computed naively must equal the cheap coefficient-flip version
+    # the cheap coefficient-flip must equal the true p^6 Frobenius
     a = rand_fq12()
+    assert a.conjugate_p6() == a ** (P**6)
     assert a.conjugate_p6() * a.conjugate_p6() == (a * a).conjugate_p6()
-    # and it must be an involution that fixes Fp2^... even powers
     assert a.conjugate_p6().conjugate_p6() == a
 
 
