@@ -1,0 +1,7 @@
+"""charon_tpu.app — node assembly, lifecycle and infrastructure.
+
+Mirrors the reference's app package (reference: app/app.go): wire the core
+workflow from a cluster lock + keys, manage ordered start/stop, expose
+monitoring.  `node.Node` is the in-process unit the simnet tests boot n of
+(reference: app/simnet_test.go:57-197 runs a 3-node cluster in one process).
+"""

@@ -1,0 +1,30 @@
+"""charon_tpu.core — the duty workflow (the heart of the framework).
+
+Re-creation of the reference's core package (reference: core/), re-designed
+for Python asyncio + batched TPU crypto:
+
+- `types`       Duty, DutyType, Slot, the four data abstractions and Sets
+- `interfaces`  component protocols + `wire()` (reference: core/interfaces.go)
+- `deadline`    duty Deadliner (reference: core/deadline.go)
+- `dutydb`      blocking-query unsigned-data store (reference: core/dutydb)
+- `parsigdb`    partial-signature store w/ threshold trigger
+- `sigagg`      batched threshold aggregation — THE TPU kernel call-site
+- `aggsigdb`    aggregate store with blocking Await
+- `bcast`       beacon-node broadcaster
+- `fetcher`     unsigned duty data fetcher
+- `scheduler`   slot ticker + duty resolver
+- `validatorapi` beacon-API façade for validator clients
+- `consensus`   QBFT-backed consensus wrapper (core/qbft is standalone)
+- `tracker`     per-duty failure analysis sidecar
+
+Two idioms carried over from the reference (docs/architecture.md:198-200):
+components only meet through `wire()` callbacks, and all crossing values are
+immutable (frozen dataclasses — Python's equivalent of the Clone() rule).
+"""
+
+from .types import (Duty, DutyType, Slot, ParSignedData,
+                    new_attester_duty, new_proposer_duty, new_randao_duty)
+from .interfaces import wire
+
+__all__ = ["Duty", "DutyType", "Slot", "ParSignedData", "wire",
+           "new_attester_duty", "new_proposer_duty", "new_randao_duty"]

@@ -1,0 +1,137 @@
+"""Consensus component — adapts QBFT to the duty workflow.
+
+Mirrors reference core/consensus/component.go:
+- one QBFT instance per duty (component.go:240-309), created on local
+  propose() or on the first inbound message for that duty,
+- values are UnsignedDataSets in canonical hashable form (the reference
+  hashes protos to [32]byte; frozen dataclasses make the set itself the
+  comparable value),
+- deterministic leader = (slot + type + round) % n (component.go:536-538),
+- round timer 0.75s + 0.25s·round (component.go:540-548), configurable,
+- per-duty buffered receive queues, GC'd when instances finish.
+
+The transport is injected (in-memory `ConsensusMemNetwork` for simnet; the
+p2p mesh version sits behind the same broadcast/subscribe pair).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict
+from typing import Any
+
+from . import qbft
+from .types import Duty, DutyType, UnsignedDataSet
+
+
+def to_value(unsigned: UnsignedDataSet) -> tuple:
+    """Canonical hashable value for consensus (sorted by pubkey)."""
+    return tuple(sorted(unsigned.items(), key=lambda kv: kv[0]))
+
+
+def from_value(value: tuple) -> UnsignedDataSet:
+    return dict(value)
+
+
+def duty_leader(duty: Duty, round_: int, nodes: int) -> int:
+    """reference: component.go:536-538."""
+    return (duty.slot + int(duty.type) + round_) % nodes
+
+
+class ConsensusMemNetwork:
+    """In-memory consensus transport: duty-scoped broadcast to all nodes,
+    including the sender (QBFT requires self-delivery)."""
+
+    def __init__(self) -> None:
+        self._nodes: list[QBFTConsensus] = []
+
+    def register(self, node: "QBFTConsensus") -> None:
+        self._nodes.append(node)
+
+    async def broadcast(self, duty: Duty, msg: qbft.Msg) -> None:
+        for node in list(self._nodes):
+            await node._deliver(duty, msg)
+
+
+class QBFTConsensus:
+    def __init__(self, transport: ConsensusMemNetwork, peer_idx: int,
+                 nodes: int, round_timeout_base: float = 0.75,
+                 round_timeout_inc: float = 0.25):
+        self._net = transport
+        self._peer_idx = peer_idx
+        self._nodes = nodes
+        self._base = round_timeout_base
+        self._inc = round_timeout_inc
+        self._subs: list = []
+        self._queues: dict[Duty, asyncio.Queue] = {}
+        self._tasks: dict[Duty, asyncio.Task] = {}
+        self._decided: set[Duty] = set()
+        self._trimmed: "OrderedDict[Duty, None]" = OrderedDict()
+        transport.register(self)
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    # -- duty instance management ------------------------------------------
+
+    def _queue(self, duty: Duty) -> asyncio.Queue:
+        q = self._queues.get(duty)
+        if q is None:
+            q = asyncio.Queue()
+            self._queues[duty] = q
+        return q
+
+    def _definition(self, duty: Duty) -> qbft.Definition:
+        async def decide(instance: Any, value: Any, justification) -> None:
+            if duty in self._decided:
+                return
+            self._decided.add(duty)
+            for fn in self._subs:
+                await fn(duty, from_value(value))
+
+        return qbft.Definition(
+            is_leader=lambda inst, rnd, proc: duty_leader(
+                duty, rnd, self._nodes) == proc,
+            round_timeout=lambda rnd: self._base + self._inc * rnd,
+            nodes=self._nodes,
+            decide=decide,
+        )
+
+    def _ensure_instance(self, duty: Duty, input_value: Any) -> None:
+        if duty in self._tasks:
+            return
+        q = self._queue(duty)
+
+        async def bcast(msg: qbft.Msg) -> None:
+            await self._net.broadcast(duty, msg)
+
+        t = qbft.Transport(bcast, q)
+        self._tasks[duty] = asyncio.get_event_loop().create_task(
+            qbft.run(self._definition(duty), t, duty, self._peer_idx,
+                     input_value))
+
+    # -- interface ----------------------------------------------------------
+
+    async def propose(self, duty: Duty, unsigned: UnsignedDataSet) -> None:
+        """Start (or join) this duty's consensus with our proposed value."""
+        self._ensure_instance(duty, to_value(unsigned))
+
+    async def _deliver(self, duty: Duty, msg: qbft.Msg) -> None:
+        # Inbound messages may arrive before our own propose(); they buffer
+        # in the per-duty queue and are consumed once the instance starts at
+        # propose() (reference: component.go:376-408 buffered recv channels).
+        # Stragglers for GC'd duties are dropped, not re-buffered.
+        if duty in self._trimmed:
+            return
+        await self._queue(duty).put(msg)
+
+    def trim(self, duty: Duty) -> None:
+        """Deadliner GC (reference: component.go:376-408 deadline sweep)."""
+        task = self._tasks.pop(duty, None)
+        if task is not None:
+            task.cancel()
+        self._queues.pop(duty, None)
+        self._decided.discard(duty)
+        self._trimmed[duty] = None
+        while len(self._trimmed) > 4096:  # bounded straggler-drop memory
+            self._trimmed.popitem(last=False)

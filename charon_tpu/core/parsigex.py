@@ -1,0 +1,52 @@
+"""ParSigEx — full-mesh exchange of partial signatures.
+
+Mirrors reference core/parsigex/parsigex.go: broadcast one node's partial
+signatures to the n−1 peers; inbound sets are signature-verified against
+the SENDER's pubshare before storage (parsigex.go:152-176 NewEth2Verifier).
+
+`MemParSigExNetwork` is the in-memory transport used by simnet tests
+(reference: core/parsigex/memory.go); the p2p-backed implementation lives
+in charon_tpu.p2p and plugs in via the same interface.
+"""
+
+from __future__ import annotations
+
+from .types import Duty, ParSignedDataSet
+
+
+class MemParSigExNetwork:
+    """Shared hub: wires n in-process nodes into a full mesh."""
+
+    def __init__(self) -> None:
+        self._nodes: list[MemParSigEx] = []
+
+    def join(self, verify_fn=None) -> "MemParSigEx":
+        node = MemParSigEx(self, len(self._nodes), verify_fn)
+        self._nodes.append(node)
+        return node
+
+    async def _fanout(self, from_idx: int, duty: Duty,
+                      pset: ParSignedDataSet) -> None:
+        for node in self._nodes:
+            if node._idx != from_idx:
+                await node._receive(duty, pset)
+
+
+class MemParSigEx:
+    def __init__(self, net: MemParSigExNetwork, idx: int, verify_fn=None):
+        self._net = net
+        self._idx = idx
+        self._verify_fn = verify_fn  # async (duty, pset) -> None, raises
+        self._subs: list = []
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    async def broadcast(self, duty: Duty, pset: ParSignedDataSet) -> None:
+        await self._net._fanout(self._idx, duty, pset)
+
+    async def _receive(self, duty: Duty, pset: ParSignedDataSet) -> None:
+        if self._verify_fn is not None:
+            await self._verify_fn(duty, pset)  # raises on bad sigs
+        for fn in self._subs:
+            await fn(duty, pset)

@@ -1,0 +1,184 @@
+"""Scheduler — slot ticker + per-epoch duty resolution.
+
+Mirrors reference core/scheduler/scheduler.go:
+- slot ticker derived from genesis time + slot duration (scheduler.go:483-545),
+  skipping missed slots to avoid thundering herds (scheduler.go:525-532),
+- resolves attester/proposer duties per epoch from the beacon API for the
+  cluster's validators (scheduler.go:248-421), current and next epoch,
+- emits subscribe_slots ticks and subscribe_duties triggers at per-type slot
+  offsets: attester ⅓ slot, aggregator/sync-contribution ⅔ slot
+  (reference: core/scheduler/offset.go:24-29),
+- get_duty_definition serves resolved definitions (blocking until the epoch
+  is resolved, like the reference's await).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+
+from .types import (AttesterDefinition, Duty, DutyDefinitionSet, DutyType,
+                    ProposerDefinition, PubKey, SlotTick)
+
+# Fraction of the slot at which each duty fires (offset.go:24-29).
+DUTY_OFFSETS: dict[DutyType, float] = {
+    DutyType.ATTESTER: 1 / 3,
+    DutyType.AGGREGATOR: 2 / 3,
+    DutyType.SYNC_CONTRIBUTION: 2 / 3,
+    DutyType.PROPOSER: 0.0,
+    DutyType.BUILDER_PROPOSER: 0.0,
+    DutyType.PREPARE_AGGREGATOR: 0.0,
+    DutyType.SYNC_MESSAGE: 1 / 3,
+}
+
+
+# Duty types the scheduler triggers through the fetcher.  Others (e.g.
+# PREPARE_AGGREGATOR, RANDAO) are VC-initiated via the validator API; their
+# definitions are still resolved for get_duty_definition lookups
+# (reference: scheduler.go only schedules attester/proposer/sync families).
+_FETCHED_TYPES = (DutyType.ATTESTER, DutyType.AGGREGATOR, DutyType.PROPOSER,
+                  DutyType.BUILDER_PROPOSER, DutyType.SYNC_CONTRIBUTION)
+
+
+class Scheduler:
+    def __init__(self, eth2cl, pubkeys: list[PubKey],
+                 builder_api: bool = False):
+        self._eth2cl = eth2cl
+        self._pubkeys = list(pubkeys)
+        self._builder_api = builder_api
+        self._duty_subs: list = []
+        self._slot_subs: list = []
+        self._defs: dict[Duty, DutyDefinitionSet] = {}
+        self._def_waiters: dict[Duty, list[asyncio.Future]] = defaultdict(list)
+        self._resolved_epochs: set[int] = set()
+        self._stop = False
+        self._tasks: list[asyncio.Task] = []
+
+    # -- interface ----------------------------------------------------------
+
+    def subscribe_duties(self, fn) -> None:
+        self._duty_subs.append(fn)
+
+    def subscribe_slots(self, fn) -> None:
+        self._slot_subs.append(fn)
+
+    async def get_duty_definition(self, duty: Duty) -> DutyDefinitionSet:
+        """Blocks until the duty's epoch is resolved
+        (reference: scheduler.go GetDutyDefinition awaits resolution)."""
+        if duty in self._defs:
+            return dict(self._defs[duty])
+        spe = (await self._eth2cl.spec())["SLOTS_PER_EPOCH"]
+        if duty.slot // spe in self._resolved_epochs:
+            return {}  # epoch resolved, no such duty
+        fut = asyncio.get_event_loop().create_future()
+        self._def_waiters[duty].append(fut)
+        return await fut
+
+    # -- run loop -----------------------------------------------------------
+
+    async def run(self) -> None:
+        """Slot ticker; returns when stop() is called."""
+        spec = await self._eth2cl.spec()
+        genesis = await self._eth2cl.genesis_time()
+        slot_dur = spec["SECONDS_PER_SLOT"]
+        spe = spec["SLOTS_PER_EPOCH"]
+
+        while not self._stop:
+            now = time.time()
+            slot_num = max(0, int((now - genesis) // slot_dur))
+            slot_start = genesis + slot_num * slot_dur
+            if slot_start + slot_dur <= time.time():
+                await asyncio.sleep(0)  # missed; recompute (skip, :525-532)
+                continue
+            tick = SlotTick(slot_num, slot_start, slot_dur, spe)
+
+            await self._resolve_epoch_if_needed(tick)
+            for fn in self._slot_subs:
+                await fn(tick)
+            self._schedule_slot_duties(tick)
+
+            next_start = slot_start + slot_dur
+            await asyncio.sleep(max(0.0, next_start - time.time()))
+
+    def stop(self) -> None:
+        self._stop = True
+        for t in self._tasks:
+            t.cancel()
+
+    # -- resolution ---------------------------------------------------------
+
+    async def _resolve_epoch_if_needed(self, tick: SlotTick) -> None:
+        for epoch in (tick.epoch, tick.epoch + 1):
+            if epoch not in self._resolved_epochs:
+                await self._resolve_duties(epoch, tick)
+                self._resolved_epochs.add(epoch)
+                self._sweep_waiters(epoch, tick.slots_per_epoch)
+
+    def _sweep_waiters(self, epoch: int, spe: int) -> None:
+        """Resolve waiters for duties this epoch did NOT produce with an
+        empty set, so callers never hang on a duty that doesn't exist."""
+        for duty in list(self._def_waiters):
+            if duty.slot // spe == epoch and duty not in self._defs:
+                for fut in self._def_waiters.pop(duty):
+                    if not fut.done():
+                        fut.set_result({})
+
+    async def _resolve_duties(self, epoch: int, tick: SlotTick) -> None:
+        """reference: scheduler.go:248-421 resolveDuties."""
+        vals = await self._eth2cl.active_validators(self._pubkeys)
+        indices = {v.index: pk for pk, v in vals.items()}
+        if not indices:
+            return
+
+        for ad in await self._eth2cl.attester_duties(epoch, list(indices)):
+            pubkey = indices[ad.validator_index]
+            att_def = AttesterDefinition(
+                pubkey=pubkey, slot=ad.slot,
+                validator_index=ad.validator_index,
+                committee_index=ad.committee_index,
+                committee_length=ad.committee_length,
+                committees_at_slot=ad.committees_at_slot,
+                validator_committee_index=ad.validator_committee_index)
+            for dtype in (DutyType.ATTESTER, DutyType.PREPARE_AGGREGATOR,
+                          DutyType.AGGREGATOR):
+                self._set_definition(Duty(ad.slot, dtype), pubkey, att_def)
+
+        for pd in await self._eth2cl.proposer_duties(epoch, list(indices)):
+            pubkey = indices[pd.validator_index]
+            prop_def = ProposerDefinition(
+                pubkey=pubkey, slot=pd.slot,
+                validator_index=pd.validator_index)
+            dtype = (DutyType.BUILDER_PROPOSER if self._builder_api
+                     else DutyType.PROPOSER)
+            self._set_definition(Duty(pd.slot, dtype), pubkey, prop_def)
+
+    def _set_definition(self, duty: Duty, pubkey: PubKey, d) -> None:
+        self._defs.setdefault(duty, {})[pubkey] = d
+        for fut in self._def_waiters.pop(duty, []):
+            if not fut.done():
+                fut.set_result(dict(self._defs[duty]))
+
+    # -- triggering ---------------------------------------------------------
+
+    def _schedule_slot_duties(self, tick: SlotTick) -> None:
+        """Spawn one task per duty of this slot, firing at its offset
+        (reference: scheduler.go:173-245)."""
+        for duty, defset in list(self._defs.items()):
+            if duty.slot != tick.slot or duty.type not in _FETCHED_TYPES:
+                continue
+            offset = DUTY_OFFSETS.get(duty.type, 0.0)
+            fire_at = tick.time + offset * tick.slot_duration
+            self._tasks.append(asyncio.get_event_loop().create_task(
+                self._fire(duty, dict(defset), fire_at)))
+
+    async def _fire(self, duty: Duty, defset: DutyDefinitionSet,
+                    fire_at: float) -> None:
+        await asyncio.sleep(max(0.0, fire_at - time.time()))
+        for fn in self._duty_subs:
+            try:
+                await fn(duty, defset)
+            except Exception:
+                import logging
+                logging.getLogger("charon_tpu.scheduler").exception(
+                    "duty subscriber failed for %s", duty)

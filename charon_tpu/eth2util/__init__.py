@@ -1,0 +1,7 @@
+"""eth2util — Ethereum consensus-layer primitives for the duty pipeline.
+
+Mirrors the reference's eth2util package surface (reference: eth2util/):
+SSZ hash-tree-roots (ssz.py), spec types (spec.py), signing domains
+(signing.py), network/fork registry (network.py), EIP-2335 keystores
+(keystore.py), deposit data (deposit.py).
+"""

@@ -1,0 +1,13 @@
+"""charon_tpu.ops — batched BLS12-381 arithmetic for TPU (JAX/XLA/Pallas).
+
+This package is the TPU replacement for the reference's CPU crypto dependency
+(kryptology `curves/native/bls12381`, reference: tbls/tss.go:21-23): field
+arithmetic, curve groups, pairings and MSMs, all written as batched JAX
+programs so one kernel launch serves an entire validator set
+(reference batching axis: docs/architecture.md:126-128).
+
+Layout convention: a base-field element is an int32 array of 32×12-bit
+little-endian limbs on the LAST axis; every op is vectorised over arbitrary
+leading batch dimensions and is jit/vmap/shard_map-safe (static shapes, no
+data-dependent control flow).
+"""

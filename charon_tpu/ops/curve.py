@@ -1,0 +1,297 @@
+"""Batched G1/G2 Jacobian point arithmetic for BLS12-381 on TPU.
+
+Replaces the reference's kryptology curve layer (reference: tbls/tss.go:21-23)
+with branch-free, batched JAX ops: one code path serves G1 (coords in Fp,
+[..., 32]) and G2 (coords in Fp2, [..., 2, 32]) via a small field-ops table.
+
+Points are Jacobian (X, Y, Z) in Montgomery form, stacked on axis −(ndim+1);
+infinity is encoded Z = 0 and every op is total: exceptional cases
+(P = ±Q, P = ∞) are resolved with `select`, never Python branches, so the
+whole group law jits to straight-line XLA and vectorises over the validator
+batch (the `*Set` axis of the reference, docs/architecture.md:126-128).
+
+Correctness oracle: charon_tpu.tbls.ref.curve (affine, arbitrary precision).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import fp, tower
+from ..tbls.ref import curve as refcurve
+from ..tbls.ref.fields import FQ2, P, R
+
+
+# ---------------------------------------------------------------------------
+# Field-ops table: the group law below is generic over Fp / Fp2
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FieldOps:
+    name: str
+    elem_ndim: int  # trailing dims of one element (1 for Fp, 2 for Fp2)
+    add: Callable
+    sub: Callable
+    neg: Callable
+    mul: Callable
+    sqr: Callable
+    dbl: Callable
+    mul_small: Callable
+    inv: Callable
+    is_zero: Callable
+    eq: Callable
+    select: Callable
+    one_m: Any   # Montgomery 1 constant (numpy)
+    b_m: Any     # curve coefficient b in Montgomery form (numpy)
+
+
+FP_OPS = FieldOps(
+    name="fp", elem_ndim=1,
+    add=fp.add, sub=fp.sub, neg=fp.neg, mul=fp.mul, sqr=fp.sqr,
+    dbl=fp.double, mul_small=fp.mul_small, inv=fp.inv,
+    is_zero=fp.is_zero, eq=fp.eq, select=fp.select,
+    one_m=fp.ONE_M,
+    b_m=fp.to_limbs(4 * fp.R_MONT % P),
+)
+
+F2_OPS = FieldOps(
+    name="fp2", elem_ndim=2,
+    add=tower.f2_add, sub=tower.f2_sub, neg=tower.f2_neg, mul=tower.f2_mul,
+    sqr=tower.f2_sqr, dbl=tower.f2_double, mul_small=tower.f2_mul_small,
+    inv=tower.f2_inv, is_zero=tower.f2_is_zero, eq=tower.f2_eq,
+    select=tower.f2_select,
+    one_m=tower.F2_ONE_M,
+    b_m=tower.f2_pack([FQ2([4, 4])])[0],  # twist: y² = x³ + 4(u+1)
+)
+
+
+# ---------------------------------------------------------------------------
+# Point helpers.  A point is [..., 3, *elem] with coords stacked on axis
+# -(elem_ndim+1).
+# ---------------------------------------------------------------------------
+
+def _coords(F: FieldOps, pt):
+    ax = -(F.elem_ndim + 1)
+    x, y, z = jnp.split(pt, 3, axis=ax)
+    return x.squeeze(ax), y.squeeze(ax), z.squeeze(ax)
+
+
+def make_point(F: FieldOps, x, y, z):
+    return jnp.stack([x, y, z], axis=-(F.elem_ndim + 1))
+
+
+def point_select(F: FieldOps, cond, a, b):
+    c = cond[(...,) + (None,) * (F.elem_ndim + 1)]
+    return jnp.where(c, a, b)
+
+
+def inf_point(F: FieldOps, batch_shape=()):
+    """Infinity: (1, 1, 0) in Montgomery form."""
+    one = jnp.asarray(np.asarray(F.one_m))
+    zero = jnp.zeros_like(one)
+    pt = jnp.stack([one, one, zero])
+    return jnp.broadcast_to(pt, batch_shape + pt.shape)
+
+
+def is_inf(F: FieldOps, pt):
+    _, _, z = _coords(F, pt)
+    return F.is_zero(z)
+
+
+def from_affine(F: FieldOps, x, y, inf=None):
+    one = jnp.broadcast_to(jnp.asarray(np.asarray(F.one_m)), x.shape)
+    z = one
+    if inf is not None:
+        z = F.select(inf, jnp.zeros_like(one), one)
+    return make_point(F, x, y, z)
+
+
+def neg_point(F: FieldOps, pt):
+    x, y, z = _coords(F, pt)
+    return make_point(F, x, F.neg(y), z)
+
+
+def double_point(F: FieldOps, pt):
+    """dbl-2009-l (a = 0).  Z=0 (infinity) maps to Z3 = 0 automatically."""
+    x1, y1, z1 = _coords(F, pt)
+    a = F.sqr(x1)
+    b = F.sqr(y1)
+    c = F.sqr(b)
+    d = F.dbl(F.sub(F.sub(F.sqr(F.add(x1, b)), a), c))
+    e = F.mul_small(a, 3)
+    f = F.sqr(e)
+    x3 = F.sub(f, F.dbl(d))
+    y3 = F.sub(F.mul(e, F.sub(d, x3)), F.mul_small(c, 8))
+    z3 = F.dbl(F.mul(y1, z1))
+    return make_point(F, x3, y3, z3)
+
+
+def add_points(F: FieldOps, p1, p2):
+    """Complete addition: add-2007-bl with select-resolved exceptional cases
+    (P=Q → doubling; P=−Q → ∞ falls out of the formula; P or Q = ∞)."""
+    x1, y1, z1 = _coords(F, p1)
+    x2, y2, z2 = _coords(F, p2)
+    z1z1 = F.sqr(z1)
+    z2z2 = F.sqr(z2)
+    u1 = F.mul(x1, z2z2)
+    u2 = F.mul(x2, z1z1)
+    s1 = F.mul(F.mul(y1, z2), z2z2)
+    s2 = F.mul(F.mul(y2, z1), z1z1)
+    h = F.sub(u2, u1)
+    i = F.sqr(F.dbl(h))
+    j = F.mul(h, i)
+    r = F.dbl(F.sub(s2, s1))
+    v = F.mul(u1, i)
+    x3 = F.sub(F.sub(F.sqr(r), j), F.dbl(v))
+    y3 = F.sub(F.mul(r, F.sub(v, x3)), F.dbl(F.mul(s1, j)))
+    z3 = F.mul(F.sub(F.sub(F.sqr(F.add(z1, z2)), z1z1), z2z2), h)
+    raw = make_point(F, x3, y3, z3)
+
+    same = F.is_zero(h) & F.is_zero(r)  # P == Q (in the group sense)
+    out = point_select(F, same, double_point(F, p1), raw)
+    out = point_select(F, is_inf(F, p1), p2, out)
+    out = point_select(F, is_inf(F, p2), p1, out)
+    return out
+
+
+def to_affine(F: FieldOps, pt):
+    """Jacobian → affine (x, y, is_inf).  Infinity maps to (0, 0, True)
+    because inv(0) = 0 in the fp layer."""
+    x, y, z = _coords(F, pt)
+    zinv = F.inv(z)
+    zinv2 = F.sqr(zinv)
+    return (F.mul(x, zinv2), F.mul(y, F.mul(zinv, zinv2)), F.is_zero(z))
+
+
+def eq_points(F: FieldOps, p1, p2):
+    """Group-element equality across different Jacobian representatives."""
+    x1, y1, z1 = _coords(F, p1)
+    x2, y2, z2 = _coords(F, p2)
+    z1z1 = F.sqr(z1)
+    z2z2 = F.sqr(z2)
+    ex = F.eq(F.mul(x1, z2z2), F.mul(x2, z1z1))
+    ey = F.eq(F.mul(F.mul(y1, z2), z2z2), F.mul(F.mul(y2, z1), z1z1))
+    i1, i2 = F.is_zero(z1), F.is_zero(z2)
+    return (i1 & i2) | (~i1 & ~i2 & ex & ey)
+
+
+def on_curve(F: FieldOps, pt):
+    """Y² = X³ + b·Z⁶ (vacuously true at ∞)."""
+    x, y, z = _coords(F, pt)
+    z3 = F.mul(z, F.sqr(z))
+    rhs = F.add(F.mul(F.sqr(x), x),
+                F.mul(jnp.asarray(np.asarray(F.b_m)), F.sqr(z3)))
+    return F.eq(F.sqr(y), rhs) | F.is_zero(z)
+
+
+# ---------------------------------------------------------------------------
+# Scalar multiplication / MSM
+# ---------------------------------------------------------------------------
+
+SCALAR_BITS = 256
+
+
+def scalars_to_bits(scalars) -> np.ndarray:
+    """Host: list of ints (mod R) → [len, 256] int32 bit planes, MSB first."""
+    out = np.zeros((len(scalars), SCALAR_BITS), np.int32)
+    for n, s in enumerate(scalars):
+        s = int(s) % R
+        for i in range(SCALAR_BITS):
+            out[n, i] = (s >> (SCALAR_BITS - 1 - i)) & 1
+    return out
+
+
+def scalar_mul(F: FieldOps, pt, bits):
+    """Batched double-and-add, MSB-first.  `pt` [..., 3, elem], `bits`
+    [..., 256] int32.  Constant trip count, branch-free: XLA-friendly."""
+
+    def body(i, acc):
+        acc = double_point(F, acc)
+        added = add_points(F, acc, pt)
+        return point_select(F, bits[..., i] == 1, added, acc)
+
+    return lax.fori_loop(0, SCALAR_BITS, body,
+                         inf_point(F, pt.shape[:-(F.elem_ndim + 1) - 1]))
+
+
+def sum_points(F: FieldOps, pts, axis: int = 0):
+    """Reduce an axis of points by group addition (log-depth tree)."""
+    ax = axis if axis >= 0 else axis + pts.ndim
+    n = pts.shape[ax]
+    while n > 1:
+        half = n // 2
+        lo = lax.slice_in_dim(pts, 0, half, axis=ax)
+        hi = lax.slice_in_dim(pts, half, 2 * half, axis=ax)
+        rest = lax.slice_in_dim(pts, 2 * half, n, axis=ax)
+        pairsum = add_points(F, lo, hi)
+        pts = jnp.concatenate([pairsum, rest], axis=ax)
+        n = half + (n - 2 * half)
+    return jnp.take(pts, 0, axis=ax)
+
+
+def msm(F: FieldOps, pts, bits, axis: int = 0):
+    """Σ scalarᵢ·Pᵢ along `axis`: batched scalar-mul then tree reduction —
+    the Lagrange-interpolation shape of tbls.Aggregate
+    (reference: tbls/tss.go:142-149)."""
+    return sum_points(F, scalar_mul(F, pts, bits), axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Generators / host conversions (oracle points ↔ limb planes)
+# ---------------------------------------------------------------------------
+
+def g1_pack(pts) -> np.ndarray:
+    """Host: list of oracle G1 affine points (or None) → [len, 3, 32]."""
+    out = np.zeros((len(pts), 3, fp.NLIMBS), np.int32)
+    for n, pt in enumerate(pts):
+        if pt is None:
+            out[n, 0] = fp.ONE_M
+            out[n, 1] = fp.ONE_M
+        else:
+            out[n, 0] = fp.to_limbs(pt[0].n * fp.R_MONT % P)
+            out[n, 1] = fp.to_limbs(pt[1].n * fp.R_MONT % P)
+            out[n, 2] = fp.ONE_M
+    return out
+
+
+def g2_pack(pts) -> np.ndarray:
+    """Host: list of oracle G2 affine points (or None) → [len, 3, 2, 32]."""
+    out = np.zeros((len(pts), 3, 2, fp.NLIMBS), np.int32)
+    for n, pt in enumerate(pts):
+        if pt is None:
+            out[n, 0] = tower.F2_ONE_M
+            out[n, 1] = tower.F2_ONE_M
+        else:
+            out[n, 0] = tower.f2_pack([pt[0]])[0]
+            out[n, 1] = tower.f2_pack([pt[1]])[0]
+            out[n, 2] = tower.F2_ONE_M
+    return out
+
+
+def g1_unpack(pts_jac) -> list:
+    """Device Jacobian [..., 3, 32] → list of oracle affine points."""
+    x, y, inf = to_affine(FP_OPS, pts_jac)
+    xs = fp.unpack(fp.from_mont(x))
+    ys = fp.unpack(fp.from_mont(y))
+    infs = np.asarray(inf).reshape(-1)
+    from ..tbls.ref.fields import FQ
+    return [None if i else (FQ(a), FQ(b)) for a, b, i in zip(xs, ys, infs)]
+
+
+def g2_unpack(pts_jac) -> list:
+    """Device Jacobian [..., 3, 2, 32] → list of oracle affine points."""
+    x, y, inf = to_affine(F2_OPS, pts_jac)
+    xs = tower.f2_unpack(x)
+    ys = tower.f2_unpack(y)
+    infs = np.asarray(inf).reshape(-1)
+    return [None if i else (a, b) for a, b, i in zip(xs, ys, infs)]
+
+
+G1_GEN = g1_pack([refcurve.G1_GEN])[0]
+G2_GEN = g2_pack([refcurve.G2_GEN])[0]

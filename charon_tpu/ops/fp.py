@@ -1,0 +1,239 @@
+"""Batched Fp arithmetic for BLS12-381 on TPU: 32×12-bit int32 limb planes.
+
+This is the TPU-native answer to the reference's fiat-crypto-generated 64-bit
+field ops (kryptology `curves/native/bls12381`, consumed via
+reference tbls/tss.go:21-23).  Design constraints that picked this shape:
+
+- TPU has no native 64-bit integer path; int32 multiply-accumulate on the VPU
+  is the fast primitive.  12-bit limbs keep every partial product < 2^24 and
+  every schoolbook convolution column < 32·2^24 = 2^29, so the whole
+  multiplier runs in exact int32 with headroom for the Montgomery pass
+  (peak < ~2^30, bound proven in `mul`).
+- All functions are shape-polymorphic over leading batch dims: an element is
+  `[..., 32]` int32, limb axis last, little-endian.  Everything is pure jnp +
+  lax, jit/vmap/shard_map-safe: fixed trip counts, no data-dependent control
+  flow, so XLA can fuse and tile freely.
+- Multiplication is Montgomery (R = 2^384) via a 32-step `lax.scan` that
+  shifts the accumulator down one limb per step — static shapes, no dynamic
+  slicing.
+
+Correctness oracle: charon_tpu.tbls.ref.fields (differential tests in
+tests/test_ops_fp.py), per SURVEY.md §4's CPU-vs-TPU differential-test rule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tbls.ref.fields import P
+
+LIMB_BITS = 12
+NLIMBS = 32  # 32 × 12 = 384 bits ≥ 381-bit p
+MASK = (1 << LIMB_BITS) - 1
+DTYPE = jnp.int32
+
+# Montgomery constants for R = 2^(12·32) = 2^384.
+R_MONT = pow(2, LIMB_BITS * NLIMBS, P)
+R2_INT = R_MONT * R_MONT % P
+N0INV = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (numpy; used at trace time and in tests)
+# ---------------------------------------------------------------------------
+
+def to_limbs(x: int, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Integer → little-endian 12-bit limb vector (host side)."""
+    assert 0 <= x < 1 << (LIMB_BITS * nlimbs)
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(nlimbs)],
+                    dtype=np.int32)
+
+
+def from_limbs(limbs) -> int:
+    """Limb vector (1-D) → integer (host side)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+
+
+def pack(xs) -> np.ndarray:
+    """List/array of ints (standard form) → [len, NLIMBS] limb array."""
+    return np.stack([to_limbs(int(x) % P) for x in xs])
+
+
+def unpack(arr) -> list[int]:
+    """[..., NLIMBS] limb array → flat list of ints."""
+    a = np.asarray(arr).reshape(-1, arr.shape[-1])
+    return [from_limbs(row) for row in a]
+
+
+P_LIMBS = to_limbs(P)
+P_PAD = np.concatenate([P_LIMBS, np.zeros(NLIMBS, np.int32)])  # for the reducer
+ZERO = to_limbs(0)
+ONE = to_limbs(1)            # standard-form 1
+ONE_M = to_limbs(R_MONT)     # Montgomery-form 1
+R2 = to_limbs(R2_INT)
+
+
+# ---------------------------------------------------------------------------
+# Carry machinery
+# ---------------------------------------------------------------------------
+
+def carry(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Propagate (possibly negative) limb overflows; return (canonical limbs
+    in [0, 2^12), final carry).  Signed arithmetic-shift semantics make the
+    same scan serve as a borrow chain for subtraction."""
+    xs = jnp.moveaxis(x, -1, 0)
+
+    def step(c, xi):
+        v = xi + c
+        return v >> LIMB_BITS, v & MASK
+
+    c, ys = lax.scan(step, jnp.zeros(x.shape[:-1], DTYPE), xs)
+    return jnp.moveaxis(ys, 0, -1), c
+
+
+def cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """Subtract p iff x ≥ p.  Input canonical limbs, value < 2p."""
+    d, borrow = carry(x - jnp.asarray(P_LIMBS))
+    return jnp.where((borrow < 0)[..., None], x, d)
+
+
+# ---------------------------------------------------------------------------
+# Ring ops (all inputs canonical < p unless noted; outputs canonical < p)
+# ---------------------------------------------------------------------------
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    s, _ = carry(a + b)
+    return cond_sub_p(s)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    s, _ = carry(a - b + jnp.asarray(P_LIMBS))
+    return cond_sub_p(s)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+def double(a: jnp.ndarray) -> jnp.ndarray:
+    return add(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a·k for a small static positive k, by binary double-and-add so every
+    intermediate stays < 2p (k·a directly could overflow the 32-limb span)."""
+    assert k >= 1
+    acc = None
+    addend = a
+    while k:
+        if k & 1:
+            acc = addend if acc is None else add(acc, addend)
+        k >>= 1
+        if k:
+            addend = double(addend)
+    return acc
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a·b·R⁻¹ mod p.
+
+    Overflow proof (int32): schoolbook column ≤ 32·(2^12−1)² < 2^29; during
+    reduction each surviving column gains ≤ 32 further m·p_j terms (< 2^29)
+    plus one ≤ 2^19 carry, so peak magnitude < 2^30 < 2^31.  The scan shifts
+    the accumulator down one limb per step, keeping shapes static.
+    """
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    # Schoolbook convolution as a 32-step scan (compact HLO: the pairing
+    # kernels contain tens of thousands of these): step i adds aᵢ·(b << i).
+    b_pad = jnp.concatenate([b, jnp.zeros_like(b)], axis=-1)
+
+    def conv_step(state, a_i):
+        acc, bs = state
+        acc = acc + a_i[..., None] * bs
+        return (acc, jnp.roll(bs, 1, axis=-1)), None
+
+    (prod, _), _ = lax.scan(
+        conv_step,
+        (jnp.zeros(shape[:-1] + (2 * NLIMBS,), DTYPE), b_pad),
+        jnp.moveaxis(a, -1, 0))
+
+    p_pad = jnp.asarray(P_PAD)
+
+    def step(t, _):
+        m = ((t[..., 0] & MASK) * N0INV) & MASK
+        t = t + m[..., None] * p_pad
+        c = t[..., 0] >> LIMB_BITS
+        t = jnp.concatenate([t[..., 1:], jnp.zeros_like(t[..., :1])], axis=-1)
+        t = t.at[..., 0].add(c)
+        return t, None
+
+    t, _ = lax.scan(step, prod, None, length=NLIMBS)
+    lo, _ = carry(t[..., :NLIMBS])  # value < 2p ⇒ no final carry
+    return cond_sub_p(lo)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def to_mont(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, jnp.asarray(R2))
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, jnp.asarray(ONE))
+
+
+def pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e (Montgomery in, Montgomery out) for a compile-time exponent."""
+    if e == 0:
+        return jnp.broadcast_to(jnp.asarray(ONE_M), a.shape)
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], DTYPE)
+
+    def body(i, state):
+        result, base = state
+        r2 = mul(result, base)
+        result = jnp.where((bits[i] == 1)[..., None], r2, result)
+        return result, sqr(base)
+
+    one = jnp.broadcast_to(jnp.asarray(ONE_M), a.shape)
+    result, _ = lax.fori_loop(0, nbits, body, (one, a))
+    return result
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """a⁻¹ via Fermat (Montgomery in/out).  inv(0) = 0 by convention (used
+    by the curve layer for the point at infinity's Z)."""
+    return pow_fixed(a, P - 2)
+
+
+# ---------------------------------------------------------------------------
+# Predicates / selection
+# ---------------------------------------------------------------------------
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b, cond shaped like the batch dims."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def sgn(a_std: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic sign of a STANDARD-form element (ZCash serialisation):
+    1 iff a > (p−1)/2, i.e. iff a ≥ (p+1)/2.  Mirrors ref.fields.FQ.sgn."""
+    _, borrow = carry(a_std - jnp.asarray(to_limbs((P + 1) // 2)))
+    return borrow >= 0

@@ -1,0 +1,205 @@
+"""Batched optimal-ate pairing on BLS12-381 for TPU.
+
+The product-of-pairings check Π e(Pᵢ, Qᵢ) = 1 is the core of signature
+verification — the op the reference runs twice per partial signature on CPU
+(reference: tbls/tss.go:200-217) and that this module turns into one batched,
+jittable kernel (BASELINE.md north star).
+
+Design (all branch-free, batched over leading dims):
+- Miller loop over the static bits of |z| (z = BLS parameter, negative),
+  unrolled at trace time: 62 doubling steps, 5 addition steps.
+- G2 accumulator in homogeneous projective coords on the M-twist; line
+  evaluations produce sparse (c0, c1, c4) Fp2 triples consumed by
+  `tower.f12_mul_by_014`.  Line formulas are derived from the affine slope
+  scaled by 2YZ² (doubling) / δ (addition); the scale factors live in Fp2,
+  which the final exponentiation annihilates (c^(p⁶−1) = 1 for c ∈ Fp2).
+- Final exponentiation: easy part f^((p⁶−1)(p²+1)), then the hard part to
+  the power 3·(p⁴−p²+1)/r via the verified identity
+      3·(p⁴−p²+1)/r = (z−1)²·(z+p)·(z²+p²−1) + 3
+  (checked against integers in tests/test_ops_pairing.py).  The extra cube
+  is harmless for is-one checks since gcd(3, r) = 1.
+
+Correctness oracle: charon_tpu.tbls.ref.pairing (jax result == oracle³).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import fp
+from .tower import (F12_ONE_M, f2_mul, f2_mul_fp, f2_select, f2_sqr, f2_sub,
+                    f2_add, f2_mul_small, f12_conj, f12_eq, f12_frob, f12_inv,
+                    f12_mul, f12_mul_by_014, f12_select, f12_sqr)
+from ..tbls.ref.fields import BLS_X
+
+# Bits of |z| below the leading one, MSB first — the Miller loop schedule.
+_LOOP_BITS = [int(b) for b in bin(BLS_X)[3:]]
+
+
+def _proj(x, y, one):
+    """Affine Fp2 point → homogeneous projective (X, Y, Z=1)."""
+    return x, y, one
+
+
+def _dbl_step(X, Y, Z):
+    """Projective doubling on the twist (EFD dbl-2007-bl, a=0) + line coeffs.
+
+    Line ℓ through 2·R evaluated at P, scaled by 2YZ²:
+        c0 = 2Y²Z − 3X³, c1 = 3X²Z·xP, c4 = −2YZ²·yP
+    (c1/c4 bases returned; the xP/−yP scaling happens in `_ell`).
+    """
+    XX = f2_sqr(X)
+    YY = f2_sqr(Y)
+    w = f2_mul_small(XX, 3)            # 3X²
+    s = f2_mul(Y, Z)                   # YZ
+    B = f2_mul(f2_mul(X, Y), s)        # XY²Z
+    h = f2_sub(f2_sqr(w), f2_mul_small(B, 8))
+    X3 = f2_mul_small(f2_mul(h, s), 2)
+    Y3 = f2_sub(f2_mul(w, f2_sub(f2_mul_small(B, 4), h)),
+                f2_mul_small(f2_mul(YY, f2_sqr(s)), 8))
+    Z3 = f2_mul_small(f2_mul(s, f2_sqr(s)), 8)
+    c0 = f2_sub(f2_mul_small(f2_mul(YY, Z), 2), f2_mul(w, X))
+    c1b = f2_mul(w, Z)                 # × xP
+    c4b = f2_mul_small(f2_mul(s, Z), 2)  # × (−yP)
+    return (X3, Y3, Z3), c0, c1b, c4b
+
+
+def _add_step(X1, Y1, Z1, x2, y2):
+    """Mixed addition R + Q (Q affine) + line coeffs, scaled by δ:
+        θ = Y1 − y2·Z1, δ = X1 − x2·Z1
+        c0 = δ·y2 − θ·x2, c1 = θ·xP, c4 = −δ·yP
+    """
+    theta = f2_sub(Y1, f2_mul(y2, Z1))
+    delta = f2_sub(X1, f2_mul(x2, Z1))
+    c = f2_sqr(theta)
+    d = f2_sqr(delta)
+    e = f2_mul(delta, d)
+    f_ = f2_mul(Z1, c)
+    g = f2_mul(X1, d)
+    h = f2_sub(f2_add(e, f_), f2_mul_small(g, 2))
+    X3 = f2_mul(delta, h)
+    Y3 = f2_sub(f2_mul(theta, f2_sub(g, h)), f2_mul(e, Y1))
+    Z3 = f2_mul(Z1, e)
+    c0 = f2_sub(f2_mul(delta, y2), f2_mul(theta, x2))
+    return (X3, Y3, Z3), c0, theta, delta
+
+
+def _ell(f, c0, c1b, c4b, xp, yp_neg):
+    """Multiply f by the sparse line value."""
+    return f12_mul_by_014(f, c0, f2_mul_fp(c1b, xp), f2_mul_fp(c4b, yp_neg))
+
+
+def miller_loop(p_g1, q_g2):
+    """f_{|z|,Q}(P), conjugated for the negative BLS parameter — matches the
+    oracle's miller_loop up to an Fp2 factor killed by final exponentiation.
+
+    `p_g1` [..., 3, 32], `q_g2` [..., 3, 2, 32]: packed points whose Z limb
+    plane is 1 (affine) or 0 (infinity) — the layout `curve.g1_pack` /
+    `curve.g2_pack` produce.  Pairs with an infinity member contribute 1.
+    """
+    xp, yp = p_g1[..., 0, :], p_g1[..., 1, :]
+    p_inf = fp.is_zero(p_g1[..., 2, :])
+    x2, y2 = q_g2[..., 0, :, :], q_g2[..., 1, :, :]
+    q_inf = jnp.all(q_g2[..., 2, :, :] == 0, axis=(-1, -2))
+    yp_neg = fp.neg(yp)
+
+    one = jnp.asarray(F12_ONE_M)
+    batch = jnp.broadcast_shapes(xp.shape[:-1], x2.shape[:-2])
+    f0 = jnp.broadcast_to(one, batch + one.shape)
+
+    X0 = jnp.broadcast_to(x2, batch + x2.shape[-2:])
+    Y0 = jnp.broadcast_to(y2, batch + y2.shape[-2:])
+    Z0 = jnp.broadcast_to(jnp.asarray(np.stack([fp.ONE_M, fp.ZERO])), Y0.shape)
+    bits = jnp.asarray(_LOOP_BITS, jnp.int32)
+
+    # fori_loop (not unrolled) keeps the HLO compact; the rare addition step
+    # is computed every iteration and select-ed in on the 5 set bits.
+    def body(i, state):
+        f, X, Y, Z = state
+        f = f12_sqr(f)
+        (X, Y, Z), c0, c1b, c4b = _dbl_step(X, Y, Z)
+        f = _ell(f, c0, c1b, c4b, xp, yp_neg)
+        (Xa, Ya, Za), c0a, c1a, c4a = _add_step(X, Y, Z, x2, y2)
+        fa = _ell(f, c0a, c1a, c4a, xp, yp_neg)
+        take = bits[i] == 1
+        return (f12_select(take, fa, f), f2_select(take, Xa, X),
+                f2_select(take, Ya, Y), f2_select(take, Za, Z))
+
+    f, X, Y, Z = lax.fori_loop(0, len(_LOOP_BITS), body, (f0, X0, Y0, Z0))
+
+    f = f12_conj(f)  # negative parameter
+    return f12_select(p_inf | q_inf, jnp.broadcast_to(one, f.shape), f)
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation
+# ---------------------------------------------------------------------------
+
+_ABS_Z_BITS = [int(b) for b in bin(BLS_X)[3:]]
+
+
+def _exp_abs_z(g):
+    """g^|z| by square-and-multiply over the static parameter bits (compact
+    fori_loop).  Uses plain Fp12 squaring (valid everywhere; the cyclotomic
+    fast path is a future optimisation)."""
+    bits = jnp.asarray(_ABS_Z_BITS, jnp.int32)
+
+    def body(i, acc):
+        acc = f12_sqr(acc)
+        return f12_select(bits[i] == 1, f12_mul(acc, g), acc)
+
+    return lax.fori_loop(0, len(_ABS_Z_BITS), body, g)
+
+
+def _exp_z(g):
+    """g^z for the (negative) BLS parameter; g must be in the cyclotomic
+    subgroup so inversion is conjugation."""
+    return f12_conj(_exp_abs_z(g))
+
+
+def final_exponentiate(f):
+    """f^(3·(p¹²−1)/r) — the oracle's final exponentiation, cubed."""
+    # Easy part: f^((p⁶−1)(p²+1)).  After this, f is cyclotomic (unitary).
+    f = f12_mul(f12_conj(f), f12_inv(f))
+    f = f12_mul(f12_frob(f12_frob(f)), f)
+    # Hard part: exponent (z−1)²(z+p)(z²+p²−1) + 3  ==  3(p⁴−p²+1)/r.
+    t0 = f12_mul(_exp_z(f), f12_conj(f))            # f^(z−1)
+    t1 = f12_mul(_exp_z(t0), f12_conj(t0))          # f^(z−1)²
+    t2 = f12_mul(_exp_z(t1), f12_frob(t1))          # f^((z−1)²(z+p))
+    t3 = _exp_z(_exp_z(t2))                         # ^z²
+    t5 = f12_mul(f12_mul(t3, f12_frob(f12_frob(t2))), f12_conj(t2))
+    f3 = f12_mul(f12_sqr(f), f)
+    return f12_mul(t5, f3)
+
+
+def pairing(p_g1, q_g2):
+    """e(P, Q)³ ∈ GT — batched.  The cube is transparent to every equality
+    and product-is-one use (gcd(3, r) = 1)."""
+    return final_exponentiate(miller_loop(p_g1, q_g2))
+
+
+def pairing_product_is_one(ps, qs, pair_axis: int = 0):
+    """Π_k e(P_k, Q_k) == 1, one shared final exponentiation — the batched
+    verification primitive (oracle: ref.pairing.multi_pairing_is_one).
+
+    `ps` [..., K, 3, 32], `qs` [..., K, 3, 2, 32] with the product over axis
+    `pair_axis`; returns bool [...].
+    """
+    f = miller_loop(ps, qs)
+    # pair_axis indexes the batch dims (f minus its 4 trailing element dims)
+    ax = pair_axis if pair_axis >= 0 else f.ndim - 4 + pair_axis
+    prod = f
+    k = f.shape[ax]
+    while k > 1:
+        half = k // 2
+        lo = jnp.take(prod, jnp.arange(0, half), axis=ax)
+        hi = jnp.take(prod, jnp.arange(half, 2 * half), axis=ax)
+        rest = jnp.take(prod, jnp.arange(2 * half, k), axis=ax)
+        prod = jnp.concatenate([f12_mul(lo, hi), rest], axis=ax)
+        k = half + (k - 2 * half)
+    prod = jnp.take(prod, 0, axis=ax)
+    one = jnp.broadcast_to(jnp.asarray(F12_ONE_M), prod.shape)
+    return f12_eq(final_exponentiate(prod), one)

@@ -1,0 +1,332 @@
+"""Batched BLS12-381 extension-field tower on TPU: Fp2 → Fp6 → Fp12.
+
+Fast 2-3-2 tower (the one the reference's kryptology dependency also uses
+internally, reference: tbls/tss.go:21-23):
+
+    Fp2  = Fp[u]/(u² + 1)               [..., 2, 32] int32 limbs
+    Fp6  = Fp2[v]/(v³ − ξ), ξ = u + 1   [..., 3, 2, 32]
+    Fp12 = Fp6[w]/(w² − v)              [..., 2, 3, 2, 32]
+
+All elements are in Montgomery form; every op is vectorised over arbitrary
+leading batch dims (the validator-batch axis of the sigagg kernels).  The
+single-variable oracle tower (charon_tpu.tbls.ref.fields.FQ12, modulus
+w¹² − 2w⁶ + 2) is related by w_tower = w_oracle, u = w⁶ − 1; the conversion
+used by the differential tests lives in `f12_to_oracle` / `f12_from_oracle`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import fp
+from ..tbls.ref.fields import FQ2, FQ12, P
+
+# ---------------------------------------------------------------------------
+# Fp2: a0 + a1·u, u² = −1
+# ---------------------------------------------------------------------------
+
+f2_add = fp.add
+f2_sub = fp.sub
+f2_neg = fp.neg
+f2_double = fp.double
+
+
+def f2(c0: jnp.ndarray, c1: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def f2_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = fp.mul(a0, b0)
+    t1 = fp.mul(a1, b1)
+    t2 = fp.mul(fp.add(a0, a1), fp.add(b0, b1))
+    return f2(fp.sub(t0, t1), fp.sub(t2, fp.add(t0, t1)))
+
+
+def f2_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return f2(fp.mul(fp.add(a0, a1), fp.sub(a0, a1)),
+              fp.double(fp.mul(a0, a1)))
+
+
+def f2_mul_fp(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Multiply both coefficients by an Fp scalar s [..., 32]."""
+    return f2(fp.mul(a[..., 0, :], s), fp.mul(a[..., 1, :], s))
+
+
+def f2_conj(a: jnp.ndarray) -> jnp.ndarray:
+    return f2(a[..., 0, :], fp.neg(a[..., 1, :]))
+
+
+def f2_mul_by_xi(a: jnp.ndarray) -> jnp.ndarray:
+    """×ξ = (1 + u): (a0 − a1) + (a0 + a1)u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return f2(fp.sub(a0, a1), fp.add(a0, a1))
+
+
+def f2_inv(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm_inv = fp.inv(fp.add(fp.sqr(a0), fp.sqr(a1)))
+    return f2(fp.mul(a0, norm_inv), fp.neg(fp.mul(a1, norm_inv)))
+
+
+def f2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def f2_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=(-1, -2))
+
+
+def f2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def f2_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    return jnp.stack([fp.mul_small(a[..., 0, :], k),
+                      fp.mul_small(a[..., 1, :], k)], axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Fp6: a0 + a1·v + a2·v², v³ = ξ
+# ---------------------------------------------------------------------------
+
+def f6(c0: jnp.ndarray, c1: jnp.ndarray, c2: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def _f6c(a):
+    return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+
+
+f6_add = fp.add
+f6_sub = fp.sub
+f6_neg = fp.neg
+f6_double = fp.double
+
+
+def f6_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a0, a1, a2 = _f6c(a)
+    b0, b1, b2 = _f6c(b)
+    v0 = f2_mul(a0, b0)
+    v1 = f2_mul(a1, b1)
+    v2 = f2_mul(a2, b2)
+    c0 = f2_add(v0, f2_mul_by_xi(
+        f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)), f2_add(v1, v2))))
+    c1 = f2_add(f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)),
+                       f2_add(v0, v1)),
+                f2_mul_by_xi(v2))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)),
+                       f2_add(v0, v2)),
+                v1)
+    return f6(c0, c1, c2)
+
+
+def f6_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return f6_mul(a, a)
+
+
+def f6_mul_by_v(a: jnp.ndarray) -> jnp.ndarray:
+    """×v: (ξ·a2, a0, a1)."""
+    a0, a1, a2 = _f6c(a)
+    return f6(f2_mul_by_xi(a2), a0, a1)
+
+
+def f6_mul_by_01(a: jnp.ndarray, d0: jnp.ndarray, d1: jnp.ndarray) -> jnp.ndarray:
+    """Multiply by sparse d0 + d1·v (pairing line-function helper)."""
+    a0, a1, a2 = _f6c(a)
+    v0 = f2_mul(a0, d0)
+    v1 = f2_mul(a1, d1)
+    c0 = f2_add(v0, f2_mul_by_xi(
+        f2_sub(f2_mul(f2_add(a1, a2), d1), v1)))
+    c1 = f2_sub(f2_mul(f2_add(a0, a1), f2_add(d0, d1)), f2_add(v0, v1))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), d0), v0), v1)
+    return f6(c0, c1, c2)
+
+
+def f6_mul_by_1(a: jnp.ndarray, d1: jnp.ndarray) -> jnp.ndarray:
+    """Multiply by sparse d1·v."""
+    a0, a1, a2 = _f6c(a)
+    return f6(f2_mul_by_xi(f2_mul(a2, d1)), f2_mul(a0, d1), f2_mul(a1, d1))
+
+
+def f6_mul_f2(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Scale every Fp2 coefficient by s ∈ Fp2."""
+    a0, a1, a2 = _f6c(a)
+    return f6(f2_mul(a0, s), f2_mul(a1, s), f2_mul(a2, s))
+
+
+def f6_inv(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1, a2 = _f6c(a)
+    A = f2_sub(f2_sqr(a0), f2_mul_by_xi(f2_mul(a1, a2)))
+    B = f2_sub(f2_mul_by_xi(f2_sqr(a2)), f2_mul(a0, a1))
+    C = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    F = f2_add(f2_mul(a0, A),
+               f2_mul_by_xi(f2_add(f2_mul(a2, B), f2_mul(a1, C))))
+    Finv = f2_inv(F)
+    return f6(f2_mul(A, Finv), f2_mul(B, Finv), f2_mul(C, Finv))
+
+
+def f6_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Fp12: a0 + a1·w, w² = v
+# ---------------------------------------------------------------------------
+
+def f12(c0: jnp.ndarray, c1: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def _f12c(a):
+    return a[..., 0, :, :, :], a[..., 1, :, :, :]
+
+
+f12_add = fp.add
+f12_sub = fp.sub
+
+
+def f12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = _f12c(a)
+    b0, b1 = _f12c(b)
+    aa = f6_mul(a0, b0)
+    bb = f6_mul(a1, b1)
+    c1 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)), f6_add(aa, bb))
+    c0 = f6_add(aa, f6_mul_by_v(bb))
+    return f12(c0, c1)
+
+
+def f12_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = _f12c(a)
+    v0 = f6_mul(a0, a1)
+    t = f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1)))
+    c0 = f6_sub(f6_sub(t, v0), f6_mul_by_v(v0))
+    c1 = f6_double(v0)
+    return f12(c0, c1)
+
+
+def f12_conj(a: jnp.ndarray) -> jnp.ndarray:
+    """a^(p⁶): (c0, −c1).  In GT this is the inverse (unitary elements)."""
+    a0, a1 = _f12c(a)
+    return f12(a0, f6_neg(a1))
+
+
+def f12_inv(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = _f12c(a)
+    t = f6_inv(f6_sub(f6_sqr(a0), f6_mul_by_v(f6_sqr(a1))))
+    return f12(f6_mul(a0, t), f6_neg(f6_mul(a1, t)))
+
+
+def f12_mul_by_014(a: jnp.ndarray, c0: jnp.ndarray, c1: jnp.ndarray,
+                   c4: jnp.ndarray) -> jnp.ndarray:
+    """Multiply by the sparse line value (c0 + c1·v) + (c4·v)·w  — the shape
+    produced by the M-twist line evaluation (pairing.py)."""
+    a0, a1 = _f12c(a)
+    aa = f6_mul_by_01(a0, c0, c1)
+    bb = f6_mul_by_1(a1, c4)
+    o = f2_add(c1, c4)
+    r1 = f6_sub(f6_mul_by_01(f6_add(a0, a1), c0, o), f6_add(aa, bb))
+    r0 = f6_add(f6_mul_by_v(bb), aa)
+    return f12(r0, r1)
+
+
+def f12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def f12_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+
+
+# ---------------------------------------------------------------------------
+# Frobenius (x ↦ x^p) — coefficients precomputed host-side in Montgomery form
+# ---------------------------------------------------------------------------
+
+def _fq2_const(x: FQ2) -> np.ndarray:
+    """Oracle FQ2 → Montgomery limb constant [2, 32]."""
+    c0, c1 = x.coeffs
+    return np.stack([fp.to_limbs(c0 * fp.R_MONT % P),
+                     fp.to_limbs(c1 * fp.R_MONT % P)])
+
+
+_XI = FQ2([1, 1])
+# v^p = γ1·v, v^(2p) = γ2·v², w^p = γw·w  (γ ∈ Fp2)
+FROB_G1 = _fq2_const(_XI ** ((P - 1) // 3))
+FROB_G2 = _fq2_const(_XI ** (2 * (P - 1) // 3))
+FROB_GW = _fq2_const(_XI ** ((P - 1) // 6))
+
+
+def f6_frob(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1, a2 = _f6c(a)
+    return f6(f2_conj(a0),
+              f2_mul(f2_conj(a1), jnp.asarray(FROB_G1)),
+              f2_mul(f2_conj(a2), jnp.asarray(FROB_G2)))
+
+
+def f12_frob(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = _f12c(a)
+    return f12(f6_frob(a0), f6_mul_f2(f6_frob(a1), jnp.asarray(FROB_GW)))
+
+
+# ---------------------------------------------------------------------------
+# Constants and host-side conversions (tests / serialisation boundary)
+# ---------------------------------------------------------------------------
+
+F2_ZERO = np.zeros((2, fp.NLIMBS), np.int32)
+F2_ONE_M = np.stack([fp.ONE_M, fp.ZERO])
+F6_ZERO = np.zeros((3, 2, fp.NLIMBS), np.int32)
+F6_ONE_M = np.concatenate([F2_ONE_M[None], np.zeros((2, 2, fp.NLIMBS), np.int32)])
+F12_ONE_M = np.stack([F6_ONE_M, F6_ZERO])
+
+
+def f2_pack(xs: list[FQ2]) -> np.ndarray:
+    """Oracle FQ2 list → Montgomery [len, 2, 32]."""
+    return np.stack([_fq2_const(x) for x in xs])
+
+
+def f2_unpack(arr) -> list[FQ2]:
+    """Montgomery [..., 2, 32] → flat list of oracle FQ2."""
+    a = np.asarray(arr).reshape(-1, 2, fp.NLIMBS)
+    rinv = pow(fp.R_MONT, -1, P)
+    return [FQ2([fp.from_limbs(row[0]) * rinv % P,
+                 fp.from_limbs(row[1]) * rinv % P]) for row in a]
+
+
+def f12_pack(xs: list[FQ12]) -> np.ndarray:
+    """Oracle single-variable FQ12 list → tower Montgomery [len, 2, 3, 2, 32].
+
+    Inverse of the embedding u = w⁶ − 1: tower coefficient b_m = x_m + y_m·u
+    at w^m (m = 2j + k) has y_m = c_{m+6}, x_m = c_m + c_{m+6}.
+    """
+    out = np.zeros((len(xs), 2, 3, 2, fp.NLIMBS), np.int32)
+    for n, el in enumerate(xs):
+        c = el.coeffs
+        for m in range(6):
+            y = c[m + 6]
+            x = (c[m] + y) % P
+            k, j = m % 2, m // 2
+            out[n, k, j, 0] = fp.to_limbs(x * fp.R_MONT % P)
+            out[n, k, j, 1] = fp.to_limbs(y * fp.R_MONT % P)
+    return out
+
+
+def f12_unpack(arr) -> list[FQ12]:
+    """Tower Montgomery [..., 2, 3, 2, 32] → flat list of oracle FQ12."""
+    a = np.asarray(arr).reshape(-1, 2, 3, 2, fp.NLIMBS)
+    rinv = pow(fp.R_MONT, -1, P)
+    out = []
+    for row in a:
+        coeffs = [0] * 12
+        for k in range(2):
+            for j in range(3):
+                x = fp.from_limbs(row[k, j, 0]) * rinv % P
+                y = fp.from_limbs(row[k, j, 1]) * rinv % P
+                m = 2 * j + k
+                coeffs[m] = (coeffs[m] + x - y) % P
+                coeffs[m + 6] = (coeffs[m + 6] + y) % P
+        out.append(FQ12(coeffs))
+    return out

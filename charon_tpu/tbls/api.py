@@ -57,6 +57,12 @@ class TSS:
         """Evaluate the commitment polynomial in the exponent at idx."""
         if not 1 <= idx <= self.num_shares:
             raise ValueError(f"share index {idx} out of range")
+        if self.commitments[0][0] == 0x1F:  # insecure-test scheme
+            acc, x = 0, 1
+            for c in self.commitments:
+                acc = (acc + _InsecureScheme.pk_to_sk(c) * x) % R
+                x = x * idx % R
+            return _InsecureScheme.sk_to_pk(acc)
         acc = None
         x = 1
         for c_bytes in self.commitments:
@@ -87,12 +93,17 @@ def generate_tss(threshold: int, num_shares: int,
     rng = random.Random(seed) if seed is not None else None
     sk = bls.keygen(seed)
     shares, coeffs = shamir.split_secret(sk, threshold, num_shares, rng)
-    commitments = tuple(
-        curve.g1_to_bytes(curve.multiply(curve.G1_GEN, a)) for a in coeffs
-    )
+    commitments = tuple(_commit(a) for a in coeffs)
     tss = TSS(group_pubkey=commitments[0], commitments=commitments,
               num_shares=num_shares)
     return tss, {i: int_to_privkey(s) for i, s in shares.items()}
+
+
+def _commit(coeff: int) -> PubKey:
+    """Feldman commitment of one polynomial coefficient."""
+    if _scheme == "insecure-test":
+        return _InsecureScheme.sk_to_pk(coeff)
+    return curve.g1_to_bytes(curve.multiply(curve.G1_GEN, coeff))
 
 
 def split_secret(secret: PrivKey, threshold: int,
@@ -100,9 +111,7 @@ def split_secret(secret: PrivKey, threshold: int,
     """Split an existing secret (reference: tbls/tss.go:220-270)."""
     shares, coeffs = shamir.split_secret(privkey_to_int(secret), threshold,
                                          num_shares)
-    commitments = tuple(
-        curve.g1_to_bytes(curve.multiply(curve.G1_GEN, a)) for a in coeffs
-    )
+    commitments = tuple(_commit(a) for a in coeffs)
     return (TSS(group_pubkey=commitments[0], commitments=commitments,
                 num_shares=num_shares),
             {i: int_to_privkey(s) for i, s in shares.items()})
@@ -118,10 +127,14 @@ def generate_privkey() -> PrivKey:
 
 
 def privkey_to_pubkey(sk: PrivKey) -> PubKey:
+    if _scheme == "insecure-test":
+        return _InsecureScheme.sk_to_pk(privkey_to_int(sk))
     return curve.g1_to_bytes(bls.sk_to_pk(privkey_to_int(sk)))
 
 
 def sign(sk: PrivKey, msg: bytes) -> Signature:
+    if _scheme == "insecure-test":
+        return _InsecureScheme.sign(privkey_to_int(sk), msg)
     return curve.g2_to_bytes(bls.sign(privkey_to_int(sk), msg))
 
 
@@ -131,6 +144,8 @@ partial_sign = sign
 
 
 def verify(pubkey: PubKey, msg: bytes, sig: Signature) -> bool:
+    if _scheme == "insecure-test":
+        return _InsecureScheme.verify(pubkey, msg, sig)
     try:
         pk = curve.g1_from_bytes(pubkey)
         s = curve.g2_from_bytes(sig)
@@ -172,6 +187,9 @@ def verify_and_aggregate(tss: TSS, partial_sigs: dict[int, Signature],
 
 def batch_verify(entries: list[tuple[PubKey, bytes, Signature]]) -> list[bool]:
     """Verify a batch of (pubkey, msg, signature) triples."""
+    if _scheme == "insecure-test":
+        return [_InsecureScheme.verify(pk, msg, sig)
+                for pk, msg, sig in entries]
     parsed = []
     oks = [True] * len(entries)
     for k, (pk_b, msg, sig_b) in enumerate(entries):
@@ -191,6 +209,8 @@ def threshold_combine(
         batch: list[dict[int, Signature]]) -> list[Signature]:
     """Lagrange-combine many validators' partial-signature sets at once —
     the batched MSM the TPU kernels own."""
+    if _scheme == "insecure-test":
+        return [_InsecureScheme.combine(sigs) for sigs in batch]
     parsed = [
         {i: curve.g2_from_bytes(s) for i, s in sigs.items()} for sigs in batch
     ]
@@ -247,3 +267,67 @@ def _backend():
 
 def backend_name() -> str:
     return _current.name
+
+
+# ---------------------------------------------------------------------------
+# Insecure test scheme — pipeline tests only.
+#
+# Replaces curve points with plain scalars mod r: pk = sk "in the open",
+# sign(m) = sk·h(m) mod r.  Signature LINEARITY is identical to BLS, so
+# Shamir splitting, Lagrange combination, pubshare derivation and every
+# threshold code path behave EXACTLY like the real scheme — at microsecond
+# cost.  The real BLS paths are covered by the ops differential tests and
+# dedicated backend tests; this keeps multi-node simnet tests fast
+# (the reference gets the same effect from assembly-speed BLS).
+# ---------------------------------------------------------------------------
+
+def _h_insecure(msg: bytes) -> int:
+    import hashlib
+
+    return int.from_bytes(hashlib.sha256(b"insecure-h2c" + msg).digest(),
+                          "big") % R
+
+
+class _InsecureScheme:
+    name = "insecure-test"
+
+    @staticmethod
+    def sk_to_pk(sk: int) -> bytes:
+        return b"\x1f" + sk.to_bytes(47, "big")  # flag byte marks fake keys
+
+    @staticmethod
+    def pk_to_sk(pk: bytes) -> int:
+        assert pk[0] == 0x1F, "not an insecure-test pubkey"
+        return int.from_bytes(pk[1:], "big")
+
+    @staticmethod
+    def sign(sk: int, msg: bytes) -> bytes:
+        return (sk * _h_insecure(msg) % R).to_bytes(96, "big")
+
+    @classmethod
+    def verify(cls, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        try:
+            sk = cls.pk_to_sk(pk)
+        except AssertionError:
+            return False
+        return cls.sign(sk, msg) == sig
+
+    @staticmethod
+    def combine(sigs: dict[int, bytes]) -> bytes:
+        lam = shamir.lagrange_coeffs_at_zero(list(sigs))
+        total = sum(lam[i] * int.from_bytes(s, "big") for i, s in sigs.items())
+        return (total % R).to_bytes(96, "big")
+
+
+_scheme = "bls"
+
+
+def set_scheme(name: str) -> None:
+    """'bls' (default) or 'insecure-test' (pipeline tests)."""
+    global _scheme
+    assert name in ("bls", "insecure-test")
+    _scheme = name
+
+
+def scheme_name() -> str:
+    return _scheme

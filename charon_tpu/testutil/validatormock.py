@@ -1,0 +1,101 @@
+"""ValidatorMock — in-process validator client that signs with share keys.
+
+Mirrors reference testutil/validatormock + app/vmock.go:38-298: driven by
+scheduler slot ticks, it performs the attestation flow (query duties →
+fetch attestation data → sign with the SHARE key → submit) and block
+proposals (randao reveal → request block → sign → submit) against the
+node's ValidatorAPI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.types import (Duty, DutyType, PubKey, SlotTick, pubkey_to_bytes)
+from ..core.validatorapi import ValidatorAPI
+from ..eth2util import spec
+from ..eth2util.signing import DomainName, signing_root
+from ..eth2util.ssz import Bitlist
+from ..tbls import api as tbls
+
+
+class ValidatorMock:
+    def __init__(self, vapi: ValidatorAPI,
+                 share_privkeys: dict[PubKey, bytes],
+                 fork_version: bytes,
+                 genesis_validators_root: bytes = bytes(32),
+                 slots_per_epoch: int = 16):
+        self._vapi = vapi
+        self._keys = dict(share_privkeys)  # group pubkey -> share privkey
+        self._fork = fork_version
+        self._gvr = genesis_validators_root
+        self._spe = slots_per_epoch
+
+    def _sign(self, group_pk: PubKey, domain: DomainName, root: bytes,
+              epoch: int) -> bytes:
+        sk = self._keys[group_pk]
+        return tbls.sign(sk, signing_root(domain, root, self._fork, self._gvr))
+
+    # -- slot driver --------------------------------------------------------
+
+    async def on_slot(self, slot: SlotTick) -> None:
+        """Scheduler slot subscriber.  Spawns the duty flows as tasks so the
+        scheduler tick never blocks on duty data becoming available
+        (reference: app/vmock.go spawns goroutines per flow)."""
+        import asyncio
+
+        asyncio.get_event_loop().create_task(self._run_slot(slot))
+
+    async def _run_slot(self, slot: SlotTick) -> None:
+        try:
+            await asyncio.gather(self.attest(slot), self.propose(slot))
+        except Exception:
+            import logging
+            logging.getLogger("charon_tpu.vmock").exception(
+                "vmock slot %s failed", slot.slot)
+
+    # -- attestation flow (validatormock/attest.go:43-440) ------------------
+
+    async def attest(self, slot: SlotTick) -> None:
+        duty = Duty(slot.slot, DutyType.ATTESTER)
+        defset = await self._vapi._get_duty_definition(duty)
+        for group_pk, d in (defset or {}).items():
+            if group_pk not in self._keys:
+                continue
+            data = await self._vapi.attestation_data(slot.slot,
+                                                     d.committee_index)
+            bools = [False] * d.committee_length
+            bools[d.validator_committee_index] = True
+            sig = self._sign(group_pk, DomainName.BEACON_ATTESTER,
+                             data.hash_tree_root(), data.target.epoch)
+            att = spec.Attestation(
+                aggregation_bits=Bitlist.from_bools(bools), data=data,
+                signature=sig)
+            await self._vapi.submit_attestations([att])
+
+    # -- proposal flow ------------------------------------------------------
+
+    async def propose(self, slot: SlotTick) -> None:
+        duty = Duty(slot.slot, DutyType.PROPOSER)
+        try:
+            defset = await asyncio.wait_for(
+                self._vapi._get_duty_definition(duty), timeout=0.05)
+        except asyncio.TimeoutError:
+            return
+        for group_pk, d in (defset or {}).items():
+            if group_pk not in self._keys:
+                continue
+            randao_root = SignedRandaoRoot(slot.epoch)
+            randao_sig = self._sign(group_pk, DomainName.RANDAO, randao_root,
+                                    slot.epoch)
+            block = await self._vapi.beacon_block_proposal(slot.slot,
+                                                           randao_sig)
+            sig = self._sign(group_pk, DomainName.BEACON_PROPOSER,
+                             block.hash_tree_root(), slot.epoch)
+            signed = spec.SignedBeaconBlock(message=block, signature=sig)
+            await self._vapi.submit_beacon_block(signed)
+
+
+def SignedRandaoRoot(epoch: int) -> bytes:
+    from ..eth2util import ssz
+    return ssz.uint64.hash_tree_root(epoch)

@@ -7,9 +7,18 @@ Must run before jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the dev environment pre-sets JAX_PLATFORMS=axon
+# (the tunneled TPU); tests must compile locally on CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The jaxtyping pytest plugin imports jax BEFORE conftest runs, so jax's
+# config already snapshotted JAX_PLATFORMS=axon — override it directly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

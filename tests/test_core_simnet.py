@@ -1,0 +1,158 @@
+"""Simnet integration test — a full multi-node DV cluster in one process.
+
+Mirrors the reference's crown-jewel test (app/simnet_test.go:57-197): n real
+nodes with real wiring (core.wire), in-memory parsigex + leadercast
+transports, a shared beaconmock with sub-second slots, and in-process mock
+VCs signing with share keys.  Asserts that threshold-aggregated duties
+reach the beacon node with valid GROUP signatures.
+
+Uses the insecure-test tbls scheme (identical threshold semantics, scalar
+speed); real-BLS paths are covered by tests/test_ops_* and
+tests/test_tbls_backend.py.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from charon_tpu.app.node import Node, NodeConfig
+from charon_tpu.core.leadercast import LeaderCast, MemTransportNetwork
+from charon_tpu.core.parsigex import MemParSigExNetwork
+from charon_tpu.core.types import DutyType
+from charon_tpu.eth2util.signing import DomainName, signing_root
+from charon_tpu.tbls import api as tbls
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.cluster import new_cluster_for_test
+from charon_tpu.testutil.validatormock import ValidatorMock
+
+N_NODES = 3
+THRESHOLD = 2
+N_VALS = 2
+SLOT_DUR = 0.25
+SPE = 4
+FORK = bytes.fromhex("00000000")
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def build_cluster(consensus_factory=None):
+    cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
+    bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)
+    for v in cluster.validators:
+        bmock.add_validator(v.group_pubkey)
+
+    pubshares_by_peer = {
+        idx: cluster.pubshare_map(idx) for idx in range(1, N_NODES + 1)}
+
+    psx_net = MemParSigExNetwork()
+    lc_net = MemTransportNetwork()
+    if consensus_factory is None:
+        def consensus_factory(idx):
+            return LeaderCast(lc_net, idx - 1, N_NODES)
+    nodes, vmocks = [], []
+    for idx in range(1, N_NODES + 1):
+        cfg = NodeConfig(share_idx=idx, threshold=THRESHOLD,
+                         pubshares_by_peer=pubshares_by_peer,
+                         fork_version=FORK)
+        node = Node(cfg, bmock,
+                    consensus=consensus_factory(idx),
+                    parsigex=psx_net.join(),
+                    slots_per_epoch=SPE, genesis_time=bmock.genesis,
+                    slot_duration=SLOT_DUR)
+        vmock = ValidatorMock(node.vapi, cluster.share_privkey_map(idx),
+                              FORK, slots_per_epoch=SPE)
+        node.scheduler.subscribe_slots(vmock.on_slot)
+        nodes.append(node)
+        vmocks.append(vmock)
+    return cluster, bmock, nodes
+
+
+async def run_slots(nodes, bmock, num_slots: int):
+    for n in nodes:
+        n.start()
+    deadline = time.time() + num_slots * SLOT_DUR + 2.0
+    try:
+        while time.time() < deadline:
+            await asyncio.sleep(0.1)
+            if bmock.attestations and bmock.blocks:
+                # got both duty families; allow one extra slot to settle
+                await asyncio.sleep(SLOT_DUR)
+                break
+    finally:
+        for n in nodes:
+            n.stop()
+        await asyncio.sleep(0)
+
+
+def test_simnet_attestation_and_proposal():
+    cluster, bmock, nodes = build_cluster()
+
+    asyncio.run(run_slots(nodes, bmock, num_slots=3 * SPE))
+
+    # --- attestations reached the BN with a valid GROUP signature ---
+    assert bmock.attestations, "no attestations broadcast"
+    by_group = {v.group_pubkey: v for v in cluster.validators}
+    verified = 0
+    for att in bmock.attestations:
+        root = signing_root(DomainName.BEACON_ATTESTER,
+                           att.data.hash_tree_root(), FORK)
+        for v in cluster.validators:
+            if tbls.verify(v.tss.group_pubkey, root, att.signature):
+                verified += 1
+                break
+    assert verified == len(bmock.attestations), (
+        f"only {verified}/{len(bmock.attestations)} attestations verified "
+        "against group pubkeys")
+
+    # --- block proposals (randao bootstrap flow) ---
+    assert bmock.blocks, "no blocks broadcast"
+    for blk in bmock.blocks:
+        root = signing_root(DomainName.BEACON_PROPOSER,
+                           blk.message.hash_tree_root(), FORK)
+        ok = any(tbls.verify(v.tss.group_pubkey, root, blk.signature)
+                 for v in cluster.validators)
+        assert ok, "block group signature invalid"
+
+
+def test_simnet_with_qbft_consensus():
+    """Same attestation flow but over real QBFT (byzantine-fault-tolerant)
+    consensus instead of leadercast — the reference's QBFTConsensus
+    feature-flag path (app/app.go:672-706)."""
+    from charon_tpu.core.consensus import ConsensusMemNetwork, QBFTConsensus
+
+    qnet = ConsensusMemNetwork()
+    cluster, bmock, nodes = build_cluster(
+        consensus_factory=lambda idx: QBFTConsensus(
+            qnet, idx - 1, N_NODES, round_timeout_base=0.3))
+
+    asyncio.run(run_slots(nodes, bmock, num_slots=3 * SPE))
+
+    assert bmock.attestations, "no attestations with QBFT consensus"
+    for att in bmock.attestations:
+        root = signing_root(DomainName.BEACON_ATTESTER,
+                           att.data.hash_tree_root(), FORK)
+        assert any(tbls.verify(v.tss.group_pubkey, root, att.signature)
+                   for v in cluster.validators)
+
+
+def test_simnet_tolerates_one_node_down():
+    """t-of-n graceful degradation: with n=3, t=2, one dead node must not
+    stop duties (reference smoke scenario:
+    testutil/compose/smoke/smoke_test.go:127-136)."""
+    cluster, bmock, nodes = build_cluster()
+    nodes = nodes[:-1]  # node 3 never starts
+
+    asyncio.run(run_slots(nodes, bmock, num_slots=3 * SPE))
+
+    assert bmock.attestations, "cluster stalled with one node down"
+    for att in bmock.attestations:
+        root = signing_root(DomainName.BEACON_ATTESTER,
+                           att.data.hash_tree_root(), FORK)
+        assert any(tbls.verify(v.tss.group_pubkey, root, att.signature)
+                   for v in cluster.validators)

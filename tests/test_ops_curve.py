@@ -1,0 +1,102 @@
+"""Differential tests: charon_tpu.ops.curve (batched Jacobian) vs the affine
+oracle charon_tpu.tbls.ref.curve."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from charon_tpu.ops import curve as jcurve
+from charon_tpu.ops.curve import FP_OPS, F2_OPS
+from charon_tpu.tbls.ref import curve as ref
+from charon_tpu.tbls.ref.fields import R
+
+rng = random.Random(0x5EED)
+
+N = 6
+G1_PTS = [ref.multiply(ref.G1_GEN, rng.randrange(1, R)) for _ in range(N)]
+G2_PTS = [ref.multiply(ref.G2_GEN, rng.randrange(1, R)) for _ in range(N)]
+
+
+@pytest.fixture(scope="module", params=["g1", "g2"])
+def group(request):
+    if request.param == "g1":
+        pts = G1_PTS + [ref.G1_GEN, None]
+        return FP_OPS, pts, jcurve.g1_pack, jcurve.g1_unpack, ref.add
+    pts = G2_PTS + [ref.G2_GEN, None]
+    return F2_OPS, pts, jcurve.g2_pack, jcurve.g2_unpack, ref.add
+
+
+def test_pack_roundtrip(group):
+    F, pts, pack, unpack, _ = group
+    assert unpack(jnp.asarray(pack(pts))) == pts
+
+
+def test_on_curve(group):
+    F, pts, pack, _, _ = group
+    assert np.asarray(jcurve.on_curve(F, jnp.asarray(pack(pts)))).all()
+
+
+def test_double(group):
+    F, pts, pack, unpack, _ = group
+    got = unpack(jax.jit(lambda p: jcurve.double_point(F, p))(jnp.asarray(pack(pts))))
+    assert got == [ref.double(p) for p in pts]
+
+
+def test_add_generic(group):
+    F, pts, pack, unpack, radd = group
+    a = jnp.asarray(pack(pts))
+    b = jnp.asarray(pack(list(reversed(pts))))
+    got = unpack(jax.jit(lambda x, y: jcurve.add_points(F, x, y))(a, b))
+    assert got == [radd(p, q) for p, q in zip(pts, reversed(pts))]
+
+
+def test_add_exceptional_cases(group):
+    """P+P (doubling path), P+(−P) (infinity), ∞+P, P+∞, ∞+∞."""
+    F, pts, pack, unpack, radd = group
+    p = pts[0]
+    cases = [(p, p), (p, ref.neg(p)), (None, p), (p, None), (None, None)]
+    a = jnp.asarray(pack([x for x, _ in cases]))
+    b = jnp.asarray(pack([y for _, y in cases]))
+    got = unpack(jcurve.add_points(F, a, b))
+    assert got == [radd(x, y) for x, y in cases]
+
+
+def test_eq_points(group):
+    F, pts, pack, _, _ = group
+    a = jnp.asarray(pack(pts))
+    doubled = jcurve.double_point(F, a)  # non-trivial Z
+    redoubled = jnp.asarray(pack([ref.double(p) for p in pts]))
+    assert np.asarray(jcurve.eq_points(F, doubled, redoubled)).all()
+    assert not np.asarray(jcurve.eq_points(F, a, redoubled))[:-1].any()
+
+
+def test_scalar_mul(group):
+    F, pts, pack, unpack, _ = group
+    scalars = [rng.randrange(R) for _ in range(len(pts) - 2)] + [0, 1]
+    bits = jnp.asarray(jcurve.scalars_to_bits(scalars))
+    got = unpack(jax.jit(lambda p, b: jcurve.scalar_mul(F, p, b))(
+        jnp.asarray(pack(pts)), bits))
+    assert got == [ref.multiply(p, s) for p, s in zip(pts, scalars)]
+
+
+def test_msm_lagrange_shape(group):
+    """The sigagg hot shape: Σ λᵢ·Sᵢ over a share axis, batched over
+    validators (reference: tbls/tss.go:142-149)."""
+    F, pts, pack, unpack, _ = group
+    V, T = 3, 4
+    grid = [[ref.multiply(pts[0], rng.randrange(1, R)) for _ in range(T)]
+            for _ in range(V)]
+    lams = [[rng.randrange(R) for _ in range(T)] for _ in range(V)]
+    pts_j = jnp.asarray(np.stack([pack(row) for row in grid]))      # [V,T,3,..]
+    bits = jnp.asarray(np.stack([jcurve.scalars_to_bits(row) for row in lams]))
+    got = unpack(jax.jit(lambda p, b: jcurve.msm(F, p, b, axis=1))(pts_j, bits))
+    want = []
+    for row, lrow in zip(grid, lams):
+        acc = None
+        for pt, lam in zip(row, lrow):
+            acc = ref.add(acc, ref.multiply(pt, lam))
+        want.append(acc)
+    assert got == want

@@ -1,0 +1,112 @@
+"""Differential tests: charon_tpu.ops.fp (JAX limb planes) vs Python ints.
+
+Mirrors the reference's CPU-oracle discipline (SURVEY.md §4): every batched
+TPU op is checked element-wise against arbitrary-precision arithmetic.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from charon_tpu.ops import fp
+from charon_tpu.tbls.ref.fields import P
+
+rng = random.Random(0xC0FFEE)
+
+EDGE = [0, 1, 2, P - 1, P - 2, (P - 1) // 2, (P + 1) // 2, fp.R_MONT,
+        (1 << 381) - 1]
+RAND = [rng.randrange(P) for _ in range(23)]
+VALS = EDGE + RAND
+
+
+def test_limb_roundtrip():
+    for v in VALS:
+        assert fp.from_limbs(fp.to_limbs(v)) == v
+
+
+def test_pack_unpack():
+    arr = fp.pack(VALS)
+    assert arr.shape == (len(VALS), fp.NLIMBS)
+    assert fp.unpack(arr) == [v % P for v in VALS]
+
+
+@pytest.fixture(scope="module")
+def ab():
+    a = [rng.randrange(P) for _ in range(16)] + EDGE
+    b = [rng.randrange(P) for _ in range(16)] + list(reversed(EDGE))
+    return a, b
+
+
+def test_add_sub_neg(ab):
+    a, b = ab
+    aj, bj = jnp.asarray(fp.pack(a)), jnp.asarray(fp.pack(b))
+    assert fp.unpack(jax.jit(fp.add)(aj, bj)) == [(x + y) % P for x, y in zip(a, b)]
+    assert fp.unpack(jax.jit(fp.sub)(aj, bj)) == [(x - y) % P for x, y in zip(a, b)]
+    assert fp.unpack(jax.jit(fp.neg)(aj)) == [(-x) % P for x in a]
+    assert fp.unpack(fp.double(aj)) == [2 * x % P for x in a]
+
+
+def test_mul_montgomery(ab):
+    a, b = ab
+    aj, bj = jnp.asarray(fp.pack(a)), jnp.asarray(fp.pack(b))
+    got = fp.unpack(jax.jit(fp.mul)(aj, bj))
+    rinv = pow(fp.R_MONT, -1, P)
+    assert got == [x * y * rinv % P for x, y in zip(a, b)]
+
+
+def test_mont_roundtrip(ab):
+    a, _ = ab
+    aj = jnp.asarray(fp.pack(a))
+    am = fp.to_mont(aj)
+    assert fp.unpack(am) == [x * fp.R_MONT % P for x in a]
+    assert fp.unpack(fp.from_mont(am)) == [x % P for x in a]
+
+
+def test_mul_small(ab):
+    a, _ = ab
+    aj = jnp.asarray(fp.pack(a))
+    for k in (1, 2, 3, 4, 8, 12, 16):
+        assert fp.unpack(fp.mul_small(aj, k)) == [x * k % P for x in a]
+
+
+def test_pow_and_inv():
+    a = [rng.randrange(1, P) for _ in range(6)] + [1, P - 1]
+    am = fp.to_mont(jnp.asarray(fp.pack(a)))
+    e = 0xDEADBEEFCAFE
+    got = fp.unpack(fp.from_mont(jax.jit(lambda x: fp.pow_fixed(x, e))(am)))
+    assert got == [pow(x, e, P) for x in a]
+    inv = fp.unpack(fp.from_mont(jax.jit(fp.inv)(am)))
+    assert inv == [pow(x, -1, P) for x in a]
+
+
+def test_inv_zero_is_zero():
+    z = fp.to_mont(jnp.asarray(fp.pack([0])))
+    assert fp.unpack(fp.inv(z)) == [0]
+
+
+def test_predicates(ab):
+    a, _ = ab
+    aj = jnp.asarray(fp.pack(a))
+    assert list(np.asarray(fp.is_zero(aj))) == [x % P == 0 for x in a]
+    assert list(np.asarray(fp.eq(aj, aj))) == [True] * len(a)
+    assert list(np.asarray(fp.sgn(aj))) == [x % P > (P - 1) // 2 for x in a]
+
+
+def test_batch_nd_shapes():
+    """Ops must be shape-polymorphic over leading batch dims."""
+    vals = [rng.randrange(P) for _ in range(12)]
+    arr = jnp.asarray(fp.pack(vals)).reshape(3, 4, fp.NLIMBS)
+    out = fp.add(arr, arr)
+    assert out.shape == (3, 4, fp.NLIMBS)
+    assert fp.unpack(out) == [2 * v % P for v in vals]
+
+
+def test_vmap_consistency(ab):
+    a, b = ab
+    aj, bj = jnp.asarray(fp.pack(a)), jnp.asarray(fp.pack(b))
+    direct = fp.mul(aj, bj)
+    vmapped = jax.vmap(fp.mul)(aj, bj)
+    assert (np.asarray(direct) == np.asarray(vmapped)).all()

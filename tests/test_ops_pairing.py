@@ -1,0 +1,82 @@
+"""Differential tests: charon_tpu.ops.pairing (batched JAX optimal-ate) vs
+the pure-Python oracle (charon_tpu.tbls.ref.pairing).
+
+The JAX kernel computes e(P,Q)³ (hard part exponent 3(p⁴−p²+1)/r); since
+gcd(3, r) = 1 this is compared as jax == oracle³.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from charon_tpu.ops import curve as jcurve
+from charon_tpu.ops import pairing as jpair
+from charon_tpu.ops import tower
+from charon_tpu.tbls.ref import curve as ref
+from charon_tpu.tbls.ref import pairing as refpair
+from charon_tpu.tbls.ref.fields import P, R
+
+rng = random.Random(0xE77E)
+
+
+def test_hard_part_identity():
+    z = -0xD201000000010000
+    d3 = 3 * (P**4 - P**2 + 1) // R
+    assert (z - 1) ** 2 * (z + P) * (z * z + P * P - 1) + 3 == d3
+
+
+def test_pairing_matches_oracle_cubed():
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    p1 = ref.multiply(ref.G1_GEN, a)
+    q1 = ref.multiply(ref.G2_GEN, b)
+    ps = jnp.asarray(jcurve.g1_pack([ref.G1_GEN, p1]))
+    qs = jnp.asarray(jcurve.g2_pack([ref.G2_GEN, q1]))
+    got = tower.f12_unpack(jax.jit(jpair.pairing)(ps, qs))
+    want = [refpair.pairing(ref.G1_GEN, ref.G2_GEN) ** 3,
+            refpair.pairing(p1, q1) ** 3]
+    assert got == want
+
+
+def test_bilinearity_on_device():
+    a = rng.randrange(2, R)
+    pa = ref.multiply(ref.G1_GEN, a)
+    qa = ref.multiply(ref.G2_GEN, a)
+    ps = jnp.asarray(jcurve.g1_pack([pa, ref.G1_GEN]))
+    qs = jnp.asarray(jcurve.g2_pack([ref.G2_GEN, qa]))
+    e1, e2 = tower.f12_unpack(jpair.pairing(ps, qs))
+    assert e1 == e2  # e(aP, Q) == e(P, aQ)
+
+
+def test_pairing_with_infinity_is_one():
+    ps = jnp.asarray(jcurve.g1_pack([None, ref.G1_GEN]))
+    qs = jnp.asarray(jcurve.g2_pack([ref.G2_GEN, None]))
+    one = tower.f12_unpack(jnp.asarray(tower.F12_ONE_M)[None])[0]
+    assert tower.f12_unpack(jpair.pairing(ps, qs)) == [one, one]
+
+
+def test_product_is_one_signature_shape():
+    """The BLS verification pairing equation, batched over 2 validators:
+    e(−g1, sig)·e(pk, H(m)) == 1  with sig = sk·H(m), pk = sk·g1."""
+    from charon_tpu.tbls.ref.hash_to_curve import hash_to_g2
+
+    msgs = [b"duty-attester-slot-1", b"duty-attester-slot-2"]
+    sks = [rng.randrange(1, R) for _ in msgs]
+    hms = [hash_to_g2(m) for m in msgs]
+    sigs = [ref.multiply(h, sk) for h, sk in zip(hms, sks)]
+    pks = [ref.multiply(ref.G1_GEN, sk) for sk in sks]
+
+    neg_g1 = ref.neg(ref.G1_GEN)
+    ps = np.stack([jcurve.g1_pack([neg_g1, pk]) for pk in pks])     # [V,2,...]
+    qs = np.stack([jcurve.g2_pack([s, h]) for s, h in zip(sigs, hms)])
+    ok = jax.jit(lambda p, q: jpair.pairing_product_is_one(p, q, pair_axis=1))(
+        jnp.asarray(ps), jnp.asarray(qs))
+    assert list(np.asarray(ok)) == [True, True]
+
+    # negative case: swap one signature
+    qs_bad = np.stack([jcurve.g2_pack([sigs[1], hms[0]]),
+                       jcurve.g2_pack([sigs[1], hms[1]])])
+    ok = jpair.pairing_product_is_one(jnp.asarray(ps), jnp.asarray(qs_bad),
+                                      pair_axis=1)
+    assert list(np.asarray(ok)) == [False, True]

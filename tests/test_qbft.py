@@ -1,0 +1,135 @@
+"""QBFT algorithm tests — modelled on the reference's simulated-transport
+corpus (reference: core/qbft/qbft_test.go): happy path, dead leader (round
+change), minority partition, laggard catch-up via DECIDED."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core import qbft
+from charon_tpu.core.qbft import Definition, Msg, MsgType, Transport
+
+
+class Network:
+    """In-memory broadcast network with per-process inboxes and optional
+    drop rules."""
+
+    def __init__(self, n: int):
+        self.queues = {p: asyncio.Queue() for p in range(n)}
+        self.drop = set()  # processes whose outbound messages vanish
+
+    def transport(self, process: int) -> Transport:
+        async def broadcast(msg: Msg):
+            if process in self.drop:
+                return
+            for q in self.queues.values():
+                await q.put(msg)
+        return Transport(broadcast, self.queues[process])
+
+
+def make_definition(n: int, decided: dict, timeout: float = 0.1):
+    async def decide(instance, value, justification):
+        decided.setdefault(asyncio.current_task().get_name(), value)
+
+    return Definition(
+        is_leader=lambda inst, rnd, proc: (rnd - 1) % n == proc,
+        round_timeout=lambda rnd: timeout * (1 + 0.5 * rnd),
+        nodes=n,
+        decide=decide,
+    )
+
+
+async def run_cluster(n: int, inputs, dead=(), run_for: float = 3.0,
+                      late=(), timeout: float = 0.1):
+    decided = {}
+    net = Network(n)
+    d = make_definition(n, decided, timeout)
+    tasks = {}
+
+    def start(p):
+        tasks[p] = asyncio.get_event_loop().create_task(
+            qbft.run(d, net.transport(p), "inst-1", p, inputs[p]),
+            name=f"proc-{p}")
+
+    for p in range(n):
+        if p in dead or p in late:
+            continue
+        start(p)
+    if late:
+        await asyncio.sleep(timeout * 5)
+        for p in late:
+            start(p)
+
+    deadline = asyncio.get_event_loop().time() + run_for
+    want = n - len(dead)
+    while (asyncio.get_event_loop().time() < deadline
+           and len(decided) < want):
+        await asyncio.sleep(0.02)
+    for t in tasks.values():
+        t.cancel()
+    await asyncio.sleep(0)
+    return decided
+
+
+def test_happy_path_all_decide_leader_value():
+    decided = asyncio.run(run_cluster(4, inputs=["v0", "v1", "v2", "v3"]))
+    assert len(decided) == 4
+    assert set(decided.values()) == {"v0"}  # round-1 leader is process 0
+
+
+def test_dead_leader_round_change():
+    """Round-1 leader down: timeout → round 2 → leader 1's value decided."""
+    decided = asyncio.run(
+        run_cluster(4, inputs=["v0", "v1", "v2", "v3"], dead={0}))
+    assert len(decided) == 3
+    assert set(decided.values()) == {"v1"}
+
+
+def test_quorum_lost_no_decision():
+    """With only 2 of 4 alive there is no quorum (⌈8/3⌉=3): no decision."""
+    decided = asyncio.run(
+        run_cluster(4, inputs=["v0", "v1", "v2", "v3"], dead={2, 3},
+                    run_for=1.0))
+    assert decided == {}
+
+
+def test_laggard_catches_up_via_decided():
+    """A late-started process round-changes and learns the decision from
+    DECIDED replies (Algorithm 3:17)."""
+    decided = asyncio.run(
+        run_cluster(4, inputs=["v0", "v1", "v2", "v3"], late={3},
+                    run_for=5.0))
+    assert len(decided) == 4
+    assert set(decided.values()) == {"v0"}
+
+
+def test_n_equals_3_tolerates_zero_faults():
+    decided = asyncio.run(run_cluster(3, inputs=["a", "b", "c"]))
+    assert len(decided) == 3
+    assert set(decided.values()) == {"a"}
+
+
+def test_justification_rejects_fake_round_change():
+    """A ROUND-CHANGE claiming a prepared value without quorum PREPARE
+    justification must be dropped."""
+    d = Definition(is_leader=lambda i, r, p: r % 4 == p,
+                   round_timeout=lambda r: 1.0, nodes=4)
+    fake = Msg(MsgType.ROUND_CHANGE, "i", source=2, round=3,
+               prepared_round=2, prepared_value="evil", justification=())
+    assert not qbft.is_justified(d, "i", fake)
+    # null prepared state needs no justification
+    ok = Msg(MsgType.ROUND_CHANGE, "i", source=2, round=3)
+    assert qbft.is_justified(d, "i", ok)
+
+
+def test_justified_decided_requires_quorum_commits():
+    d = Definition(is_leader=lambda i, r, p: True,
+                   round_timeout=lambda r: 1.0, nodes=4)
+    commits = tuple(Msg(MsgType.COMMIT, "i", source=s, round=1, value="v")
+                    for s in range(3))
+    good = Msg(MsgType.DECIDED, "i", source=0, round=1, value="v",
+               justification=commits)
+    assert qbft.is_justified(d, "i", good)
+    bad = Msg(MsgType.DECIDED, "i", source=0, round=1, value="v",
+              justification=commits[:2])
+    assert not qbft.is_justified(d, "i", bad)
