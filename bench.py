@@ -1,0 +1,74 @@
+"""Benchmark: batched threshold-signature aggregation on TPU.
+
+The north-star metric (BASELINE.md): threshold-aggregate an entire
+validator set's partial signatures inside one slot — the reference does
+this per-validator on CPU via kryptology's Lagrange interpolation
+(reference: tbls/tss.go:142-149 called from core/sigagg/sigagg.go:75-77).
+Here it is ONE batched Lagrange G2 MSM kernel launch for all validators.
+
+Prints exactly one JSON line:
+  {"metric": "sigagg_throughput", "value": <aggregations/s>,
+   "unit": "agg/s", "vs_baseline": <value / 100_000>}
+
+vs_baseline normalises against the BASELINE.json target rate of 10k
+validators in <100 ms p99 (= 100k aggregations/s equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as jcurve
+    from charon_tpu.ops.curve import F2_OPS
+    from charon_tpu.tbls import shamir
+    from charon_tpu.tbls.ref import curve as refcurve
+
+    V = int(sys.argv[1]) if len(sys.argv) > 1 else 1024  # validators
+    T = int(sys.argv[2]) if len(sys.argv) > 2 else 7     # threshold (7-of-10)
+    REPS = 5
+
+    # Build inputs host-side.  The device workload is value-independent, so
+    # a small pool of distinct points is tiled across the batch instead of
+    # running V·T slow host-side scalar-muls.
+    pool = [refcurve.multiply(refcurve.G2_GEN, 12345 + k) for k in range(T)]
+    row = jcurve.g2_pack(pool)                                   # [T,3,2,32]
+    pts = np.broadcast_to(row, (V,) + row.shape).copy()
+    lam = shamir.lagrange_coeffs_at_zero(list(range(1, T + 1)))
+    lrow = jcurve.scalars_to_bits([lam[i] for i in range(1, T + 1)])
+    bits = np.broadcast_to(lrow, (V,) + lrow.shape).copy()
+
+    combine = jax.jit(lambda p, b: jcurve.msm(F2_OPS, p, b, axis=1))
+    pts_d = jnp.asarray(pts)
+    bits_d = jnp.asarray(bits)
+
+    out = combine(pts_d, bits_d)        # compile + warmup
+    jax.block_until_ready(out)
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = combine(pts_d, bits_d)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+
+    best = min(times)
+    throughput = V / best
+    print(json.dumps({
+        "metric": "sigagg_throughput",
+        "value": round(throughput, 2),
+        "unit": "agg/s",
+        "vs_baseline": round(throughput / 100_000, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

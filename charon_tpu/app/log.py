@@ -1,0 +1,93 @@
+"""Structured logging: console/logfmt/JSON encoders with context-carried
+fields and topics.
+
+Mirrors reference app/log/ (zap-based structured logging with
+context-carried fields, log.go:44-148; config.go:88-141 for encoder
+selection).  The Loki push client is replaced by an injectable sink hook —
+the same role (ship structured records to an aggregator) without a
+bundled HTTP client.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+_ctx_fields: contextvars.ContextVar[dict] = contextvars.ContextVar(
+    "log_fields", default={})
+
+_sinks: list = []  # external record sinks (Loki-equivalent hook)
+
+
+def with_ctx(**fields) -> contextvars.Token:
+    """Attach fields to the current context (reference: log.WithCtx)."""
+    merged = {**_ctx_fields.get(), **fields}
+    return _ctx_fields.set(merged)
+
+
+def reset_ctx(token: contextvars.Token) -> None:
+    _ctx_fields.reset(token)
+
+
+def add_sink(fn) -> None:
+    """fn(record_dict) — e.g. a Loki-style shipper."""
+    _sinks.append(fn)
+
+
+class _Formatter(logging.Formatter):
+    def __init__(self, fmt_kind: str = "console"):
+        super().__init__()
+        self.kind = fmt_kind
+
+    def format(self, record: logging.LogRecord) -> str:
+        fields = {**_ctx_fields.get(),
+                  **getattr(record, "fields", {})}
+        base = {
+            "ts": round(record.created, 3),
+            "level": record.levelname.lower(),
+            "topic": record.name.removeprefix("charon_tpu."),
+            "msg": record.getMessage(),
+            **fields,
+        }
+        for sink in _sinks:
+            try:
+                sink(base)
+            except Exception:
+                pass
+        if self.kind == "json":
+            return json.dumps(base, sort_keys=True, default=str)
+        if self.kind == "logfmt":
+            return " ".join(f"{k}={v}" for k, v in base.items())
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        extras = " ".join(f"{k}={v}" for k, v in fields.items())
+        return (f"{ts} {record.levelname[:4]} {base['topic']:<12} "
+                f"{record.getMessage()}" + (f" [{extras}]" if extras else ""))
+
+
+def init(format: str = "console", level: str = "info") -> None:
+    """reference: log/config.go InitLogger."""
+    root = logging.getLogger("charon_tpu")
+    root.setLevel(getattr(logging, level.upper()))
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_Formatter(format))
+    root.handlers = [handler]
+
+
+def get(topic: str) -> logging.Logger:
+    return logging.getLogger(f"charon_tpu.{topic}")
+
+
+def info(topic: str, msg: str, **fields) -> None:
+    get(topic).info(msg, extra={"fields": fields})
+
+
+def warn(topic: str, msg: str, **fields) -> None:
+    get(topic).warning(msg, extra={"fields": fields})
+
+
+def error(topic: str, msg: str, **fields) -> None:
+    get(topic).error(msg, extra={"fields": fields})

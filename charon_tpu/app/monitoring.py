@@ -1,0 +1,122 @@
+"""Monitoring API: /metrics (Prometheus text format), /livez, /readyz.
+
+Mirrors reference app/monitoringapi.go:48-176: readiness = quorum of peers
+reachable AND beacon node synced; metrics registry with cluster-identity
+labels (reference: app/promauto wrapping, app/app.go:198-207).  Plain
+asyncio HTTP — no external web framework.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from typing import Callable
+
+
+class Registry:
+    """Minimal Prometheus-style registry: counters + gauges + histograms
+    with cluster-identity constant labels."""
+
+    def __init__(self, const_labels: dict | None = None):
+        self.const_labels = dict(const_labels or {})
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._hist: dict[tuple, list[float]] = defaultdict(list)
+
+    def _key(self, name: str, labels: dict | None) -> tuple:
+        merged = {**self.const_labels, **(labels or {})}
+        return (name, tuple(sorted(merged.items())))
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: dict | None = None) -> None:
+        self._counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: dict | None = None) -> None:
+        self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: dict | None = None) -> None:
+        self._hist[self._key(name, labels)].append(value)
+
+    def render(self) -> str:
+        lines = []
+        for (name, labels), v in sorted(self._counters.items()):
+            lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        for (name, labels), v in sorted(self._gauges.items()):
+            lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        for (name, labels), values in sorted(self._hist.items()):
+            n = len(values)
+            total = sum(values)
+            lines.append(f"{name}_count{_fmt_labels(labels)} {n}")
+            lines.append(f"{name}_sum{_fmt_labels(labels)} {total}")
+            if n:
+                s = sorted(values)
+                for q in (0.5, 0.9, 0.99):
+                    idx = min(n - 1, int(q * n))
+                    lines.append(
+                        f"{name}{_fmt_labels(labels + (('quantile', str(q)),))}"
+                        f" {s[idx]}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class MonitoringAPI:
+    """Serves /metrics, /livez, /readyz, /enr over plain HTTP/1.0."""
+
+    def __init__(self, registry: Registry,
+                 readyz: Callable[[], tuple[bool, str]],
+                 identity: str = ""):
+        self.registry = registry
+        self._readyz = readyz
+        self._identity = identity
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 5.0)
+            parts = request.decode().split()
+            path = parts[1] if len(parts) > 1 else "/"
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, body = self._route(path)
+            writer.write(
+                f"HTTP/1.0 {status}\r\nContent-Type: text/plain\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _route(self, path: str) -> tuple[str, bytes]:
+        if path == "/metrics":
+            return "200 OK", self.registry.render().encode()
+        if path == "/livez":
+            return "200 OK", b"ok"
+        if path == "/readyz":
+            ok, reason = self._readyz()
+            return ("200 OK", b"ok") if ok else (
+                "503 Service Unavailable", reason.encode())
+        if path == "/enr":
+            return "200 OK", self._identity.encode()
+        return "404 Not Found", b"not found"

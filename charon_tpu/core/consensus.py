@@ -106,9 +106,19 @@ class QBFTConsensus:
             await self._net.broadcast(duty, msg)
 
         t = qbft.Transport(bcast, q)
-        self._tasks[duty] = asyncio.get_event_loop().create_task(
+        task = asyncio.get_event_loop().create_task(
             qbft.run(self._definition(duty), t, duty, self._peer_idx,
                      input_value))
+
+        def _log_done(tk: asyncio.Task) -> None:
+            if not tk.cancelled() and tk.exception() is not None:
+                import logging
+
+                logging.getLogger("charon_tpu.consensus").error(
+                    "qbft instance for %s died: %r", duty, tk.exception())
+
+        task.add_done_callback(_log_done)
+        self._tasks[duty] = task
 
     # -- interface ----------------------------------------------------------
 
@@ -117,13 +127,16 @@ class QBFTConsensus:
         self._ensure_instance(duty, to_value(unsigned))
 
     async def _deliver(self, duty: Duty, msg: qbft.Msg) -> None:
-        # Inbound messages may arrive before our own propose(); they buffer
-        # in the per-duty queue and are consumed once the instance starts at
-        # propose() (reference: component.go:376-408 buffered recv channels).
         # Stragglers for GC'd duties are dropped, not re-buffered.
         if duty in self._trimmed:
             return
         await self._queue(duty).put(msg)
+        if duty not in self._tasks:
+            # First contact for this duty came from a peer: start a
+            # non-leading instance (input None) so this node still follows
+            # the cluster's decision even if its own fetch failed/lags.
+            # A later local propose() is a no-op for this duty.
+            self._ensure_instance(duty, None)
 
     def trim(self, duty: Duty) -> None:
         """Deadliner GC (reference: component.go:376-408 deadline sweep)."""

@@ -42,6 +42,8 @@ class MemDutyDB:
     # -- store --------------------------------------------------------------
 
     async def store(self, duty: Duty, unsigned: UnsignedDataSet) -> None:
+        if duty.type == DutyType.INFO_SYNC:
+            return  # priority-protocol decisions carry no duty data
         if duty.type == DutyType.ATTESTER:
             for pubkey, ud in unsigned.items():
                 self._store_attestation(duty, pubkey, ud)

@@ -103,9 +103,12 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
     """Run one consensus instance.  Decision is delivered via d.decide;
     after deciding the loop keeps serving DECIDED to round-changing
     laggards.  Runs until cancelled, exactly like the reference's
-    qbft.Run-until-ctx-done contract."""
-    if input_value is None:
-        raise ValueError("zero input value not supported")
+    qbft.Run-until-ctx-done contract.
+
+    `input_value=None` means "participate but cannot lead": the process
+    votes/commits on others' proposals but skips its own PRE-PREPARE when
+    leader with nothing justified (peers round-change past it).  This lets
+    a node whose duty fetch failed still follow the cluster's decision."""
 
     round_ = 1
     prepared_round = 0
@@ -152,7 +155,7 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
                              + d.round_timeout(round_))
 
     # Algorithm 1:11 — leader proposes in round 1.
-    if d.is_leader(instance, round_, process):
+    if d.is_leader(instance, round_, process) and input_value is not None:
         await broadcast(MsgType.PRE_PREPARE, input_value)
 
     while True:
@@ -212,7 +215,16 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
             decided_value = msg.value
             decided_evt.set()
             if d.decide is not None:
-                await d.decide(instance, msg.value, justification)
+                try:
+                    await d.decide(instance, msg.value, justification)
+                except Exception:
+                    # A failing decide sink (e.g. a DutyDB slashing clash)
+                    # must not kill the instance: we still serve DECIDED
+                    # catch-ups to lagging peers.
+                    import logging
+
+                    logging.getLogger("charon_tpu.qbft").exception(
+                        "decide callback failed for %s", instance)
             # Like the reference, keep serving DECIDED to laggards until the
             # caller cancels this instance (reference: qbft.go:264-271).
 
@@ -228,7 +240,8 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
                 _, pv = pr_pv
                 if pv is not None:
                     value = pv
-            await broadcast(MsgType.PRE_PREPARE, value, justification)
+            if value is not None:  # non-leading instances cannot propose
+                await broadcast(MsgType.PRE_PREPARE, value, justification)
 
         elif rule == UponRule.UNJUST_QUORUM_ROUND_CHANGES:
             pass  # ignore: bug or byzantine
@@ -346,6 +359,8 @@ def is_justified_decided(d: Definition, msg: Msg) -> bool:
 
 
 def is_justified_pre_prepare(d: Definition, instance: Any, msg: Msg) -> bool:
+    if msg.value is None:
+        return False  # zero-value proposals are never just
     if not d.is_leader(instance, msg.round, msg.source):
         return False
     if msg.round == 1:

@@ -69,6 +69,8 @@ class Scheduler:
         if duty in self._defs:
             return dict(self._defs[duty])
         spe = (await self._eth2cl.spec())["SLOTS_PER_EPOCH"]
+        if duty in self._defs:  # resolved while awaiting spec()
+            return dict(self._defs[duty])
         if duty.slot // spe in self._resolved_epochs:
             return {}  # epoch resolved, no such duty
         fut = asyncio.get_event_loop().create_future()

@@ -77,8 +77,16 @@ class SigAgg:
                     item.done.set_exception(exc)
             return
         for item, group_sig in zip(batch, combined):
-            signed = item.parsigs[0].data.set_signature(group_sig)
-            for fn in self._subs:
-                await fn(item.duty, item.pubkey, signed)
+            # Per-item isolation: one failing subscriber (e.g. a beacon-node
+            # broadcast error) must not strand the other items' futures or
+            # wedge the pipeline — resolve every future exactly once.
+            try:
+                signed = item.parsigs[0].data.set_signature(group_sig)
+                for fn in self._subs:
+                    await fn(item.duty, item.pubkey, signed)
+            except Exception as exc:
+                if not item.done.done():
+                    item.done.set_exception(exc)
+                continue
             if not item.done.done():
                 item.done.set_result(None)

@@ -1,0 +1,159 @@
+"""Tracker — per-duty failure root-cause analysis + participation metrics.
+
+Mirrors reference core/tracker/tracker.go: subscribe to every component's
+output events, replay each duty's event trail after its deadline,
+determine the failing step and a human-readable reason (tracker.go:275-340),
+and account per-peer participation including unexpected-participation
+detection (tracker.go:508-567).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from .types import Duty, ParSignedDataSet, PubKey
+
+
+class Step(IntEnum):
+    """Workflow steps in pipeline order (reference: tracker.go:31-42)."""
+
+    SCHEDULER = 0
+    FETCHER = 1
+    CONSENSUS = 2
+    DUTY_DB = 3
+    VALIDATOR_API = 4
+    PARSIG_DB_INTERNAL = 5
+    PARSIG_EX = 6
+    PARSIG_DB_THRESHOLD = 7
+    SIG_AGG = 8
+    AGG_SIG_DB = 9
+    BCAST = 10
+
+
+_REASONS: dict[Step, str] = {
+    Step.FETCHER: "bug: failed to fetch duty data",
+    Step.CONSENSUS: "consensus algorithm didn't complete",
+    Step.DUTY_DB: "bug: failed to store duty data in DutyDB",
+    Step.VALIDATOR_API: "signed duty not submitted by local validator client",
+    Step.PARSIG_DB_INTERNAL: "bug: partial signature not stored in local DB",
+    Step.PARSIG_EX: "bug: failed to broadcast partial signature to peers",
+    Step.PARSIG_DB_THRESHOLD:
+        "insufficient partial signatures received, minimum required threshold "
+        "not reached",
+    Step.SIG_AGG: "bug: failed to aggregate partial signatures",
+    Step.AGG_SIG_DB: "bug: failed to store aggregated signature",
+    Step.BCAST: "failed to broadcast duty to beacon node",
+}
+
+
+@dataclass
+class DutyReport:
+    duty: Duty
+    success: bool
+    failed_step: Step | None = None
+    reason: str = ""
+    participation: dict = field(default_factory=dict)  # share idx -> bool
+
+
+class Tracker:
+    """Event sink + post-deadline analyser.  Feed events via the on_* hooks
+    (wired as extra subscribers on each component), then call
+    `analyse(duty)` after the duty's deadline (Deadliner-driven in app
+    wiring)."""
+
+    def __init__(self, num_peers: int, threshold: int):
+        self._events: dict[Duty, set[Step]] = defaultdict(set)
+        self._parsigs: dict[Duty, dict[PubKey, set[int]]] = defaultdict(
+            lambda: defaultdict(set))
+        self._num_peers = num_peers
+        self._threshold = threshold
+        self.reports: list[DutyReport] = []
+        self._subs: list = []
+        # cumulative per-peer participation counters (metrics feed)
+        self.participation_counts: dict[int, int] = defaultdict(int)
+        self.duty_total: int = 0
+
+    def subscribe(self, fn) -> None:
+        """fn(report: DutyReport) on each analysed duty."""
+        self._subs.append(fn)
+
+    # -- event hooks (wire as component subscribers) ------------------------
+
+    async def on_duty_scheduled(self, duty: Duty, defset) -> None:
+        self._events[duty].add(Step.SCHEDULER)
+
+    async def on_fetched(self, duty: Duty, unsigned) -> None:
+        self._events[duty].add(Step.FETCHER)
+
+    async def on_consensus(self, duty: Duty, unsigned) -> None:
+        self._events[duty].add(Step.CONSENSUS)
+        self._events[duty].add(Step.DUTY_DB)
+
+    async def on_parsig_internal(self, duty: Duty,
+                                 pset: ParSignedDataSet) -> None:
+        self._events[duty].add(Step.VALIDATOR_API)
+        self._events[duty].add(Step.PARSIG_DB_INTERNAL)
+        self._record_parsigs(duty, pset)
+
+    async def on_parsig_external(self, duty: Duty,
+                                 pset: ParSignedDataSet) -> None:
+        self._events[duty].add(Step.PARSIG_EX)
+        self._record_parsigs(duty, pset)
+
+    async def on_threshold(self, duty: Duty, pubkey: PubKey,
+                           parsigs) -> None:
+        self._events[duty].add(Step.PARSIG_DB_THRESHOLD)
+
+    async def on_aggregated(self, duty: Duty, pubkey: PubKey, signed) -> None:
+        self._events[duty].add(Step.SIG_AGG)
+        self._events[duty].add(Step.AGG_SIG_DB)
+        self._events[duty].add(Step.BCAST)
+
+    def _record_parsigs(self, duty: Duty, pset: ParSignedDataSet) -> None:
+        for pubkey, psig in pset.items():
+            self._parsigs[duty][pubkey].add(psig.share_idx)
+
+    # -- analysis (reference: tracker.go:275-340) ---------------------------
+
+    async def analyse(self, duty: Duty) -> DutyReport:
+        """Called after the duty deadline: replay the trail, find the first
+        missing step, emit the report, GC the duty state."""
+        steps = self._events.pop(duty, set())
+        parsigs = self._parsigs.pop(duty, {})
+
+        participation = {
+            idx: any(idx in shares for shares in parsigs.values())
+            for idx in range(1, self._num_peers + 1)}
+        self.duty_total += 1
+        for idx, took_part in participation.items():
+            if took_part:
+                self.participation_counts[idx] += 1
+
+        if Step.BCAST in steps:
+            report = DutyReport(duty=duty, success=True,
+                                participation=participation)
+        else:
+            failed = Step.SCHEDULER
+            for step in Step:
+                if step not in steps:
+                    failed = step
+                    break
+            report = DutyReport(
+                duty=duty, success=False, failed_step=failed,
+                reason=_REASONS.get(failed, "unknown"),
+                participation=participation)
+        self.reports.append(report)
+        for fn in self._subs:
+            await fn(report)
+        return report
+
+    def unexpected_participants(self, duty: Duty) -> set[int]:
+        """Peers whose partial sigs arrived for a duty we never scheduled
+        (reference: tracker.go:508-567 unexpected-participation)."""
+        if Step.SCHEDULER in self._events.get(duty, set()):
+            return set()
+        return {idx for shares in self._parsigs.get(duty, {}).values()
+                for idx in shares}

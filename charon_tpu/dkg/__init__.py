@@ -1,0 +1,23 @@
+"""charon_tpu.dkg — distributed key generation ceremony.
+
+Mirrors the reference's dkg package (reference: dkg/): a ceremony driver
+(`ceremony.run_dkg`) that takes a cluster Definition, connects the
+operators over the p2p mesh, runs a keygen algorithm, signs/exchanges/
+aggregates the lock-hash and deposit-data signatures, and writes
+keystores + cluster-lock.json + deposit-data.json.
+
+Keygen algorithms:
+- `keycast`   trusted-dealer split (reference: dkg/keycast.go:34-233)
+- `pedersen`  2-round Feldman/Pedersen DKG, one instance per validator
+  run in parallel over shared transport rounds — the reference's FROST
+  DKG shape (reference: dkg/frost.go:33-125)
+
+Share verification against dealer commitments is the batched-pairing/MSM
+TPU workload of BASELINE.json config 5; the math lives behind
+tbls.feldman_verify so the device backend can batch it.
+"""
+
+from .keygen import KeygenResult, keycast_deal, pedersen_round1, pedersen_round2
+
+__all__ = ["KeygenResult", "keycast_deal", "pedersen_round1",
+           "pedersen_round2"]

@@ -1,0 +1,257 @@
+"""DKG ceremony driver — the reference's dkg.Run (reference: dkg/dkg.go:57-211).
+
+Flow: load definition → mesh up → sync barrier (all peers online, matching
+definition hash — reference dkg/dkg.go:274-333 + dkg/sync/) → run keygen
+(keycast or pedersen, all validators' instances sharing transport rounds —
+reference dkg/frost.go:62-97 runFrostParallel) → sign + exchange + verify
+lock-hash partial signatures and deposit-data signatures → write keystores,
+cluster-lock.json, deposit-data.json (reference: dkg/disk.go).
+
+Protocols:
+    /charon_tpu/dkg/sync/1.0.0      definition-hash barrier
+    /charon_tpu/dkg/round1/1.0.0    pedersen round-1 (commitments + shares)
+    /charon_tpu/dkg/keycast/1.0.0   dealer share distribution
+    /charon_tpu/dkg/lock_sig/1.0.0  lock-hash partial-signature exchange
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from ..cluster.definition import (Definition, DistValidator, Lock, lock_hash,
+                                  lock_to_json, save_json)
+from ..eth2util import deposit as deposit_mod
+from ..eth2util import keystore
+from ..eth2util.spec import DepositData
+from ..p2p.transport import TCPMesh, encode_json, decode_json
+from ..tbls import api as tbls
+from ..tbls import shamir
+from . import keygen
+
+SYNC_PROTOCOL = "/charon_tpu/dkg/sync/1.0.0"
+ROUND1_PROTOCOL = "/charon_tpu/dkg/round1/1.0.0"
+KEYCAST_PROTOCOL = "/charon_tpu/dkg/keycast/1.0.0"
+LOCKSIG_PROTOCOL = "/charon_tpu/dkg/lock_sig/1.0.0"
+
+
+class Ceremony:
+    """One operator's side of the ceremony.  `index` is 0-based (share idx
+    = index + 1)."""
+
+    def __init__(self, definition: Definition, mesh: TCPMesh, index: int,
+                 def_hash: bytes):
+        self.definition = definition
+        self.mesh = mesh
+        self.index = index
+        self.share_idx = index + 1
+        self.def_hash = def_hash
+        self.n = definition.num_operators
+        self.t = definition.threshold
+        self.m = definition.num_validators
+        # inbound state
+        self._sync_seen: dict[int, bytes] = {index: def_hash}
+        self._sync_evt = asyncio.Event()
+        self._round1: dict[int, dict] = {}   # sender -> payload
+        self._round1_evt = asyncio.Event()
+        self._keycast: dict | None = None
+        self._keycast_evt = asyncio.Event()
+        self._lock_sigs: dict[int, list] = {index: []}
+        self._locksig_evt = asyncio.Event()
+        mesh.register_handler(SYNC_PROTOCOL, self._on_sync)
+        mesh.register_handler(ROUND1_PROTOCOL, self._on_round1)
+        mesh.register_handler(KEYCAST_PROTOCOL, self._on_keycast)
+        mesh.register_handler(LOCKSIG_PROTOCOL, self._on_locksig)
+
+    # -- inbound handlers ---------------------------------------------------
+
+    async def _on_sync(self, sender: int, payload: bytes):
+        obj = decode_json(payload)
+        self._sync_seen[sender] = bytes.fromhex(obj["def_hash"])
+        if len(self._sync_seen) == self.n:
+            self._sync_evt.set()
+        return encode_json({"def_hash": self.def_hash.hex()})
+
+    async def _on_round1(self, sender: int, payload: bytes):
+        self._round1[sender] = decode_json(payload)
+        if len(self._round1) == self.n - 1:
+            self._round1_evt.set()
+        return None
+
+    async def _on_keycast(self, sender: int, payload: bytes):
+        if sender == 0:  # only the dealer (operator 0) may cast
+            self._keycast = decode_json(payload)
+            self._keycast_evt.set()
+        return None
+
+    async def _on_locksig(self, sender: int, payload: bytes):
+        self._lock_sigs[sender] = decode_json(payload)["sigs"]
+        if len(self._lock_sigs) == self.n:
+            self._locksig_evt.set()
+        return None
+
+    # -- phases -------------------------------------------------------------
+
+    async def sync_barrier(self, timeout: float = 30.0) -> None:
+        """All peers connected with a matching definition hash
+        (reference: dkg/sync/server.go:46-258)."""
+        for peer in self.mesh.peers:
+            try:
+                reply = await self.mesh.send_receive(
+                    peer, SYNC_PROTOCOL,
+                    encode_json({"def_hash": self.def_hash.hex()}),
+                    timeout=timeout)
+                self._sync_seen[peer] = bytes.fromhex(
+                    decode_json(reply)["def_hash"])
+            except asyncio.TimeoutError:
+                raise TimeoutError(f"peer {peer} unreachable in sync barrier")
+        bad = {p: h for p, h in self._sync_seen.items() if h != self.def_hash}
+        if bad:
+            raise ValueError(f"definition hash mismatch with peers {list(bad)}")
+
+    async def run_pedersen(self, timeout: float = 60.0) -> list[keygen.KeygenResult]:
+        """All m validators' 2-round DKGs sharing one transport round
+        (reference: dkg/frost.go:62-97)."""
+        # Round 1: generate for every validator, send each peer its shares.
+        my_bcasts, my_shares = [], []
+        for _ in range(self.m):
+            b, s = keygen.pedersen_round1(self.t, self.n)
+            my_bcasts.append(b)
+            my_shares.append(s)
+        for peer in self.mesh.peers:
+            payload = {
+                "commitments": [[c.hex() for c in b.commitments]
+                                for b in my_bcasts],
+                "shares": [s.shares[peer + 1].hex() for s in my_shares],
+            }
+            await self.mesh.send_async(peer, ROUND1_PROTOCOL,
+                                       encode_json(payload))
+        if self.n > 1:
+            await asyncio.wait_for(self._round1_evt.wait(), timeout)
+
+        # Round 2: verify + combine per validator.
+        results = []
+        for v in range(self.m):
+            bcasts = {self.share_idx: my_bcasts[v]}
+            shares = {self.share_idx: my_shares[v].shares[self.share_idx]}
+            for sender, payload in self._round1.items():
+                bcasts[sender + 1] = keygen.Round1Broadcast(tuple(
+                    bytes.fromhex(c) for c in payload["commitments"][v]))
+                shares[sender + 1] = bytes.fromhex(payload["shares"][v])
+            results.append(keygen.pedersen_round2(
+                self.share_idx, self.n, bcasts, shares))
+        return results
+
+    async def run_keycast(self, timeout: float = 60.0) -> list[keygen.KeygenResult]:
+        """Operator 0 deals (reference: dkg/keycast.go leader)."""
+        if self.index == 0:
+            deals = [keygen.keycast_deal(self.t, self.n)
+                     for _ in range(self.m)]
+            for peer in self.mesh.peers:
+                payload = {
+                    "validators": [{
+                        "group": g.hex(),
+                        "share": shares[peer + 1].hex(),
+                        "pubshares": {str(i): p.hex()
+                                      for i, p in pubs.items()},
+                    } for g, shares, pubs in deals]}
+                await self.mesh.send_async(peer, KEYCAST_PROTOCOL,
+                                           encode_json(payload))
+            return [keygen.KeygenResult(g, shares[1], pubs)
+                    for g, shares, pubs in deals]
+        await asyncio.wait_for(self._keycast_evt.wait(), timeout)
+        out = []
+        for v in self._keycast["validators"]:
+            out.append(keygen.KeygenResult(
+                group_pubkey=bytes.fromhex(v["group"]),
+                secret_share=bytes.fromhex(v["share"]),
+                pubshares={int(i): bytes.fromhex(p)
+                           for i, p in v["pubshares"].items()}))
+        return out
+
+    async def sign_and_aggregate(
+            self, results: list[keygen.KeygenResult],
+            withdrawal_creds: bytes,
+            timeout: float = 60.0) -> tuple[Lock, list[DepositData]]:
+        """Each node partial-signs the lock hash AND the deposit root per
+        validator; one exchange round; threshold-combine both into group
+        signatures (reference: dkg/dkg.go:336-478 signAndAggLockHash +
+        signAndAggDepositData sharing the exchanger)."""
+        validators = tuple(
+            DistValidator(
+                public_key=r.group_pubkey,
+                public_shares=tuple(r.pubshares[i + 1]
+                                    for i in range(self.n)))
+            for r in results)
+        lock = Lock(definition=self.definition, validators=validators)
+        msg = lock_hash(lock)
+        fork = self.definition.fork_version
+        dep_roots = [deposit_mod.deposit_signing_root(
+            r.group_pubkey, withdrawal_creds, fork) for r in results]
+
+        my = {"lock": [tbls.partial_sign(r.secret_share, msg).hex()
+                       for r in results],
+              "deposit": [tbls.partial_sign(r.secret_share, root).hex()
+                          for r, root in zip(results, dep_roots)]}
+        self._lock_sigs[self.index] = my
+        for peer in self.mesh.peers:
+            await self.mesh.send_async(peer, LOCKSIG_PROTOCOL,
+                                       encode_json({"sigs": my}))
+        if self.n > 1:
+            await asyncio.wait_for(self._locksig_evt.wait(), timeout)
+
+        def combine(v: int, r: keygen.KeygenResult, kind: str,
+                    root: bytes) -> bytes:
+            partials = {}
+            for sender, sigs in self._lock_sigs.items():
+                sig = bytes.fromhex(sigs[kind][v])
+                if not tbls.verify(r.pubshares[sender + 1], root, sig):
+                    raise ValueError(
+                        f"bad {kind} partial sig from operator {sender}")
+                partials[sender + 1] = sig
+            group_sig = tbls.aggregate(
+                dict(list(partials.items())[: self.t]))
+            if not tbls.verify(r.group_pubkey, root, group_sig):
+                raise ValueError(f"{kind} group signature invalid")
+            return group_sig
+
+        group_sigs, deposits = [], []
+        for v, (r, droot) in enumerate(zip(results, dep_roots)):
+            group_sigs.append(combine(v, r, "lock", msg))
+            deposits.append(DepositData(
+                pubkey=r.group_pubkey, withdrawal_credentials=withdrawal_creds,
+                amount=deposit_mod.DEPOSIT_AMOUNT_GWEI,
+                signature=combine(v, r, "deposit", droot)))
+
+        return (Lock(definition=self.definition, validators=validators,
+                     signature_aggregate=b"".join(group_sigs)), deposits)
+
+
+async def run_dkg(definition: Definition, mesh: TCPMesh, index: int,
+                  output_dir: str, algorithm: str | None = None,
+                  withdrawal_address: bytes = b"\x00" * 20) -> Lock:
+    """Full ceremony for one operator; writes outputs and returns the Lock
+    (reference: dkg/dkg.go:57-211)."""
+    from ..cluster.definition import definition_hash
+
+    algorithm = algorithm or definition.dkg_algorithm
+    cer = Ceremony(definition, mesh, index, definition_hash(definition))
+    await cer.sync_barrier()
+    if algorithm in ("default", "pedersen", "frost"):
+        results = await cer.run_pedersen()
+    elif algorithm == "keycast":
+        results = await cer.run_keycast()
+    else:
+        raise ValueError(f"unknown dkg algorithm {algorithm!r}")
+    creds = deposit_mod.withdrawal_credentials(withdrawal_address)
+    lock, deposits = await cer.sign_and_aggregate(results, creds)
+    fork = definition.fork_version
+
+    os.makedirs(output_dir, exist_ok=True)
+    keystore.store_keys([r.secret_share for r in results],
+                        os.path.join(output_dir, "validator_keys"))
+    save_json(os.path.join(output_dir, "cluster-lock.json"),
+              lock_to_json(lock))
+    deposit_mod.save_deposit_data(
+        os.path.join(output_dir, "deposit-data.json"), deposits, fork)
+    return lock

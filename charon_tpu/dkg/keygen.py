@@ -1,0 +1,97 @@
+"""DKG keygen math — transport-agnostic pure functions.
+
+Pedersen/Feldman 2-round DKG (the reference's FROST-DKG shape,
+reference: dkg/frost.go:33-125, one participant instance per validator):
+
+Round 1 (per participant i, per validator v):
+    sample f_iv of degree t−1; broadcast Feldman commitments
+    A_iv = (a_0·G, …, a_{t−1}·G); send f_iv(k) to participant k.
+Round 2 (per participant k, per validator v):
+    verify every received share against the sender's commitments;
+    final share x_kv = Σ_i f_iv(k);
+    group pubkey  = Σ_i A_iv[0];
+    summed commitments give every participant's pubshare.
+
+Keycast (trusted dealer, reference: dkg/keycast.go): the leader runs
+GenerateTSS and distributes shares — one round, weaker trust model.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..tbls import api as tbls
+from ..tbls import shamir
+from ..tbls.ref.fields import R
+
+
+@dataclass(frozen=True)
+class Round1Broadcast:
+    """Public part of a participant's round-1 output for one validator."""
+
+    commitments: tuple[bytes, ...]  # t Feldman commitments
+
+
+@dataclass(frozen=True)
+class Round1Shares:
+    """Private part: share for each receiving participant (1-based idx)."""
+
+    shares: dict  # recipient idx -> PrivKey bytes
+
+
+@dataclass(frozen=True)
+class KeygenResult:
+    """One node's view of one validator's keygen outcome."""
+
+    group_pubkey: bytes
+    secret_share: bytes                # this node's share of the group key
+    pubshares: dict                    # share idx -> pubshare (all nodes)
+
+
+def pedersen_round1(threshold: int, num_nodes: int,
+                    rng=None) -> tuple[Round1Broadcast, Round1Shares]:
+    randbelow = rng.randrange if rng is not None else (
+        lambda n: secrets.randbelow(n))
+    secret = randbelow(R)
+    shares, coeffs = shamir.split_secret(secret, threshold, num_nodes, rng)
+    return (Round1Broadcast(tuple(tbls.commit_coeff(a) for a in coeffs)),
+            Round1Shares({i: tbls.int_to_privkey(s)
+                          for i, s in shares.items()}))
+
+
+def pedersen_round2(self_idx: int, num_nodes: int,
+                    broadcasts: dict, received_shares: dict) -> KeygenResult:
+    """`broadcasts`: sender idx -> Round1Broadcast;
+    `received_shares`: sender idx -> PrivKey (this node's share from them).
+
+    Verifies every share against its sender's commitments (the batched
+    verify workload), then combines.
+    Raises ValueError naming the misbehaving sender on bad shares."""
+    if set(broadcasts) != set(received_shares):
+        raise ValueError("round1 broadcast/share sender sets differ")
+    for sender, share in received_shares.items():
+        if not tbls.feldman_verify(share, self_idx,
+                                   broadcasts[sender].commitments):
+            raise ValueError(f"invalid DKG share from participant {sender}")
+
+    secret_share = tbls.add_privkeys(list(received_shares.values()))
+    group_pubkey = tbls.add_pubkeys(
+        [b.commitments[0] for b in broadcasts.values()])
+    # summed commitment polynomial gives every node's pubshare
+    pubshares = {}
+    for k in range(1, num_nodes + 1):
+        pubshares[k] = tbls.add_pubkeys(
+            [tbls.feldman_eval(b.commitments, k)
+             for b in broadcasts.values()])
+    return KeygenResult(group_pubkey=group_pubkey,
+                        secret_share=secret_share, pubshares=pubshares)
+
+
+def keycast_deal(threshold: int, num_nodes: int,
+                 seed: bytes | None = None) -> tuple[bytes, dict, dict]:
+    """Trusted-dealer keygen for one validator: returns
+    (group_pubkey, {idx: share_privkey}, {idx: pubshare})."""
+    tss, shares = tbls.generate_tss(threshold, num_nodes, seed=seed)
+    return (tss.group_pubkey, shares,
+            {i: tss.public_share(i) for i in shares})

@@ -217,7 +217,7 @@ def scalar_mul(F: FieldOps, pt, bits):
         return point_select(F, bits[..., i] == 1, added, acc)
 
     return lax.fori_loop(0, SCALAR_BITS, body,
-                         inf_point(F, pt.shape[:-(F.elem_ndim + 1) - 1]))
+                         inf_point(F, pt.shape[: pt.ndim - (F.elem_ndim + 1)]))
 
 
 def sum_points(F: FieldOps, pts, axis: int = 0):

@@ -1,0 +1,19 @@
+"""charon_tpu.p2p — authenticated TCP mesh between cluster nodes.
+
+The reference's cluster transport is libp2p TCP + discv5 UDP + circuit
+relays (reference: p2p/, SURVEY.md §2.3).  This re-design keeps what makes
+that layer work — a full n² direct mesh (chosen over gossip for latency,
+reference docs/architecture.md:544-549), protocol-ID routing, the
+`send`/`register_handler` abstraction that lets every protocol be unit-
+tested in memory — on asyncio TCP with per-pair HMAC frame authentication
+derived from the cluster secret (see transport.py for the threat model).
+
+Discovery is static peer addressing from the cluster config (the
+reference's discv5 exists to find NATed home stakers; a TPU-pod
+deployment has stable addressing, so static + periodic reconnect is the
+idiomatic equivalent; relay support is a future round).
+"""
+
+from .transport import Peer, TCPMesh, frame_key
+
+__all__ = ["Peer", "TCPMesh", "frame_key"]

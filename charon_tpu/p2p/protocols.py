@@ -1,0 +1,78 @@
+"""Cluster protocols over the TCP mesh: parsigex + QBFT consensus.
+
+Protocol registry (the reference's catalogue, app/app.go:825-832):
+    /charon_tpu/parsigex/1.0.0    full-mesh partial-signature exchange
+    /charon_tpu/consensus/qbft/1.0.0
+    /charon_tpu/ping/1.0.0
+    /charon_tpu/peerinfo/1.0.0
+    /charon_tpu/priority/1.0.0
+
+These classes satisfy the same interfaces as the in-memory transports
+(core/parsigex.MemParSigEx, core/consensus.ConsensusMemNetwork), so the
+node wiring is identical in simnet and production — the property that
+makes the whole workflow unit-testable (reference: docs/architecture.md:198-200).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core import serialize
+from ..core.qbft import Msg
+from ..core.types import Duty, ParSignedDataSet
+
+PARSIGEX_PROTOCOL = "/charon_tpu/parsigex/1.0.0"
+CONSENSUS_PROTOCOL = "/charon_tpu/consensus/qbft/1.0.0"
+
+
+class P2PParSigEx:
+    """ParSigEx over the TCP mesh (reference: core/parsigex/parsigex.go)."""
+
+    def __init__(self, mesh, verify_fn=None):
+        self._mesh = mesh
+        self._verify_fn = verify_fn
+        self._subs: list = []
+        mesh.register_handler(PARSIGEX_PROTOCOL, self._on_frame)
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    async def broadcast(self, duty: Duty, pset: ParSignedDataSet) -> None:
+        await self._mesh.broadcast(PARSIGEX_PROTOCOL,
+                                   serialize.encode_parsig_set(duty, pset))
+
+    async def _on_frame(self, sender: int, payload: bytes):
+        duty, pset = serialize.decode_parsig_set(payload)
+        if self._verify_fn is not None:
+            await self._verify_fn(duty, pset)  # raises on invalid sigs
+        for fn in self._subs:
+            await fn(duty, pset)
+        return None
+
+
+class P2PConsensusTransport:
+    """Duty-scoped QBFT broadcast over the mesh, self-delivery included
+    (QBFT requires the sender to receive its own messages).  Plugs into
+    core.consensus.QBFTConsensus in place of ConsensusMemNetwork."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._node = None
+        mesh.register_handler(CONSENSUS_PROTOCOL, self._on_frame)
+
+    def register(self, node) -> None:
+        self._node = node
+
+    async def broadcast(self, duty: Duty, msg: Msg) -> None:
+        data = serialize.encode_consensus_msg(duty, msg)
+        await self._mesh.broadcast(CONSENSUS_PROTOCOL, data)
+        if self._node is not None:  # self-delivery
+            await self._node._deliver(duty, msg)
+
+    async def _on_frame(self, sender: int, payload: bytes):
+        duty, msg = serialize.decode_consensus_msg(payload)
+        if msg.source != sender:
+            return None  # spoofed source: drop (ECDSA-verify analogue)
+        if self._node is not None:
+            await self._node._deliver(duty, msg)
+        return None

@@ -1,0 +1,284 @@
+"""TCP mesh transport: length-prefixed, HMAC-authenticated frames with
+protocol-ID routing.
+
+Reference analogues:
+- `send_async` / `send_receive` / `register_handler`
+  (reference: p2p/sender.go:112-251, p2p/receive.go:33-94),
+- one-message-per-logical-stream framing (the reference's one-proto-per-
+  stream convention) multiplexed over one persistent connection per peer,
+- per-peer failure hysteresis logging (sender.go:53-110 semantics,
+  simplified to counters exposed for the tracker/metrics),
+- ping keepalive with RTT measurement (p2p/ping.go:37-234).
+
+Authentication: every frame carries an HMAC-SHA256 over the payload with a
+pairwise key derived from (cluster_secret, sorted peer indices).  Within
+the fixed-membership DV cluster (membership is cryptographically pinned by
+the cluster lock) this provides peer authenticity and integrity; it
+replaces libp2p's noise handshake with something with zero external deps.
+Frames also carry the sender index, verified against the pairwise key.
+
+Wire format (all big-endian):
+    u32 frame_len | u16 proto_len | proto | u8 sender | u64 msg_id |
+    u8 is_reply | payload | 32B hmac
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac as hmac_mod
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+MAX_FRAME = 32 * 1024 * 1024
+
+
+@dataclass(frozen=True)
+class Peer:
+    """Cluster peer identity (reference: p2p/peer.go:36-100).  `name` is a
+    deterministic human name derived from the index + cluster hash (the
+    reference derives it from the peer ID, p2p/name.go)."""
+
+    index: int          # 0-based peer index (share_idx - 1)
+    host: str
+    port: int
+
+    def name(self, cluster_hash: bytes = b"") -> str:
+        h = hashlib.sha256(b"name" + cluster_hash + bytes([self.index]))
+        adjectives = ["brisk", "calm", "deft", "eager", "fond", "glad",
+                      "keen", "merry", "noble", "proud", "quick", "wise"]
+        animals = ["otter", "heron", "lynx", "finch", "ibex", "koala",
+                   "marmot", "osprey", "puffin", "raven", "seal", "tern"]
+        return (f"{adjectives[h.digest()[0] % len(adjectives)]}-"
+                f"{animals[h.digest()[1] % len(animals)]}-{self.index}")
+
+
+def frame_key(cluster_secret: bytes, a: int, b: int) -> bytes:
+    """Pairwise frame-auth key for peers a and b."""
+    lo, hi = sorted((a, b))
+    return hashlib.sha256(b"p2p-frame" + cluster_secret
+                          + bytes([lo, hi])).digest()
+
+
+class TCPMesh:
+    """One node's endpoint in the full mesh."""
+
+    def __init__(self, self_index: int, peers: list[Peer],
+                 cluster_secret: bytes):
+        self.self_index = self_index
+        self.peers = {p.index: p for p in peers if p.index != self_index}
+        self.self_peer = next(p for p in peers if p.index == self_index)
+        self._secret = cluster_secret
+        self._handlers: dict[str, Callable] = {}
+        self._conns: dict[int, tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter]] = {}
+        self._conn_locks: dict[int, asyncio.Lock] = {}
+        self._pending: dict[int, asyncio.Future] = {}
+        self._msg_id = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._tasks: list[asyncio.Task] = []
+        self._inbound_writers: list[asyncio.StreamWriter] = []
+        # failure hysteresis counters (reference: p2p/sender.go:53-110)
+        self.send_failures: dict[int, int] = {}
+        self.rtts: dict[int, float] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.self_peer.host, self.self_peer.port)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for _, w in self._conns.values():
+            w.close()
+        self._conns.clear()
+        for w in self._inbound_writers:
+            w.close()
+        self._inbound_writers.clear()
+        if self._server is not None:
+            self._server.close()
+            # wait_closed() blocks until every inbound connection is done
+            # (3.12 semantics); bound it — sockets are already closed.
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    # -- handler registry (reference: p2p/receive.go:33-94) ----------------
+
+    def register_handler(self, protocol: str,
+                         fn: Callable[[int, bytes], Awaitable[bytes | None]]):
+        """fn(sender_index, payload) -> optional reply payload."""
+        self._handlers[protocol] = fn
+
+    # -- send paths (reference: p2p/sender.go:112-251) ---------------------
+
+    async def send_async(self, peer_index: int, protocol: str,
+                         payload: bytes) -> None:
+        """Fire-and-forget; failures are counted, not raised."""
+        try:
+            await self._send_frame(peer_index, protocol, payload,
+                                   msg_id=self._next_id(), is_reply=False)
+            self.send_failures[peer_index] = 0
+        except (OSError, asyncio.TimeoutError):
+            self.send_failures[peer_index] = (
+                self.send_failures.get(peer_index, 0) + 1)
+
+    async def send_receive(self, peer_index: int, protocol: str,
+                           payload: bytes, timeout: float = 5.0) -> bytes:
+        """Synchronous request/response over the mesh."""
+        msg_id = self._next_id()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            await self._send_frame(peer_index, protocol, payload,
+                                   msg_id=msg_id, is_reply=False)
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def broadcast(self, protocol: str, payload: bytes) -> None:
+        """send_async to all n−1 peers."""
+        await asyncio.gather(*(self.send_async(i, protocol, payload)
+                               for i in self.peers))
+
+    # -- ping (reference: p2p/ping.go) --------------------------------------
+
+    async def ping(self, peer_index: int) -> float:
+        t0 = asyncio.get_event_loop().time()
+        await self.send_receive(peer_index, "/charon_tpu/ping/1.0.0", b"ping")
+        rtt = asyncio.get_event_loop().time() - t0
+        self.rtts[peer_index] = rtt
+        return rtt
+
+    def enable_ping_responder(self) -> None:
+        async def _pong(sender: int, payload: bytes) -> bytes:
+            return b"pong"
+        self.register_handler("/charon_tpu/ping/1.0.0", _pong)
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_id(self) -> int:
+        self._msg_id += 1
+        return (self.self_index << 48) | self._msg_id
+
+    async def _connect(self, peer_index: int):
+        lock = self._conn_locks.setdefault(peer_index, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(peer_index)
+            if conn is not None and not conn[1].is_closing():
+                return conn
+            peer = self.peers[peer_index]
+            reader, writer = await asyncio.open_connection(peer.host,
+                                                           peer.port)
+            self._conns[peer_index] = (reader, writer)
+            # identify ourselves with one hello frame, then read replies
+            self._tasks.append(asyncio.get_event_loop().create_task(
+                self._read_loop(reader, peer_index)))
+            return reader, writer
+
+    def _encode(self, peer_index: int, protocol: str, payload: bytes,
+                msg_id: int, is_reply: bool) -> bytes:
+        proto_b = protocol.encode()
+        body = (struct.pack(">H", len(proto_b)) + proto_b
+                + bytes([self.self_index]) + struct.pack(">Q", msg_id)
+                + bytes([1 if is_reply else 0]) + payload)
+        mac = hmac_mod.new(frame_key(self._secret, self.self_index,
+                                     peer_index), body,
+                           hashlib.sha256).digest()
+        frame = body + mac
+        return struct.pack(">I", len(frame)) + frame
+
+    async def _send_frame(self, peer_index: int, protocol: str,
+                          payload: bytes, msg_id: int, is_reply: bool):
+        _, writer = await self._connect(peer_index)
+        writer.write(self._encode(peer_index, protocol, payload, msg_id,
+                                  is_reply))
+        await writer.drain()
+
+    async def _on_inbound(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        self._inbound_writers.append(writer)
+        # Serve this connection inline: start_server tracks the handler
+        # coroutine, so returning early would make wait_closed() hang on
+        # the still-running read task.
+        await self._read_loop(reader, None, writer)
+
+    async def _read_loop(self, reader: asyncio.StreamReader,
+                         expected_sender: int | None,
+                         writer: asyncio.StreamWriter | None = None) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (length,) = struct.unpack(">I", hdr)
+                if length > MAX_FRAME:
+                    return
+                frame = await reader.readexactly(length)
+                await self._on_frame(frame, expected_sender, writer)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            return
+
+    async def _on_frame(self, frame: bytes, expected_sender: int | None,
+                        writer: asyncio.StreamWriter | None) -> None:
+        body, mac = frame[:-32], frame[-32:]
+        (proto_len,) = struct.unpack(">H", body[:2])
+        off = 2
+        protocol = body[off : off + proto_len].decode()
+        off += proto_len
+        sender = body[off]
+        off += 1
+        (msg_id,) = struct.unpack(">Q", body[off : off + 8])
+        off += 8
+        is_reply = body[off] == 1
+        off += 1
+        payload = body[off:]
+
+        # authenticate: conn-gating equivalent (reference: p2p/gater.go) —
+        # frames from non-members or with bad MACs are dropped.
+        if expected_sender is not None and sender != expected_sender:
+            return
+        if sender == self.self_index or (
+                sender not in self.peers and sender != self.self_index):
+            return
+        want = hmac_mod.new(frame_key(self._secret, sender, self.self_index),
+                            body, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(want, mac):
+            return
+
+        if is_reply:
+            fut = self._pending.get(msg_id)
+            if fut is not None and not fut.done():
+                fut.set_result(payload)
+            return
+
+        handler = self._handlers.get(protocol)
+        if handler is None:
+            return
+        reply = await handler(sender, payload)
+        if reply is not None:
+            # reply on the same connection if inbound, else via our conn
+            data = self._encode(sender, protocol, reply, msg_id,
+                                is_reply=True)
+            if writer is not None and not writer.is_closing():
+                writer.write(data)
+                await writer.drain()
+            else:
+                await self._send_frame(sender, protocol, reply, msg_id,
+                                       is_reply=True)
+
+
+# ---------------------------------------------------------------------------
+# JSON codec helpers for protocol payloads
+# ---------------------------------------------------------------------------
+
+def encode_json(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":"), sort_keys=True).encode()
+
+
+def decode_json(data: bytes):
+    return json.loads(data.decode())

@@ -117,6 +117,59 @@ def split_secret(secret: PrivKey, threshold: int,
             {i: int_to_privkey(s) for i, s in shares.items()})
 
 
+# ---------------------------------------------------------------------------
+# Feldman commitment helpers (DKG building blocks; scheme-aware)
+# ---------------------------------------------------------------------------
+
+def commit_coeff(coeff: int) -> PubKey:
+    """Feldman commitment of one polynomial coefficient (public)."""
+    return _commit(coeff % R)
+
+
+def feldman_eval(commitments: tuple[PubKey, ...], idx: int) -> PubKey:
+    """Evaluate the commitment polynomial in the exponent at idx — the
+    public key of share idx under those commitments."""
+    tss = TSS(group_pubkey=commitments[0], commitments=tuple(commitments),
+              num_shares=max(idx, 1))
+    return tss.public_share(idx)
+
+
+def feldman_verify(share: PrivKey, idx: int,
+                   commitments: tuple[PubKey, ...]) -> bool:
+    """Verify a received DKG share against the dealer's commitments:
+    share·G == Σ A_j·idx^j (reference: kryptology Feldman verifier used by
+    tbls/tss.go:62-116 and dkg/frost.go share validation)."""
+    return privkey_to_pubkey(share) == feldman_eval(commitments, idx)
+
+
+def add_pubkeys(pubkeys: list[PubKey]) -> PubKey:
+    """Group-law sum of public keys (aggregating DKG contributions)."""
+    if _scheme == "insecure-test":
+        total = sum(_InsecureScheme.pk_to_sk(pk) for pk in pubkeys) % R
+        return _InsecureScheme.sk_to_pk(total)
+    acc = None
+    for pk in pubkeys:
+        acc = curve.add(acc, curve.g1_from_bytes(pk))
+    return curve.g1_to_bytes(acc)
+
+
+def add_privkeys(privkeys: list[PrivKey]) -> PrivKey:
+    return int_to_privkey(sum(privkey_to_int(sk) for sk in privkeys) % R)
+
+
+def aggregate_signatures(sigs: list[Signature]) -> Signature:
+    """Plain (non-threshold) BLS aggregate: Σ signatures.  Used for the
+    lock-hash multi-sig (reference: dkg/dkg.go:426-478
+    aggregateSignatures)."""
+    if _scheme == "insecure-test":
+        total = sum(int.from_bytes(s, "big") for s in sigs) % R
+        return total.to_bytes(96, "big")
+    acc = None
+    for s in sigs:
+        acc = curve.add(acc, curve.g2_from_bytes(s))
+    return curve.g2_to_bytes(acc)
+
+
 def combine_shares(shares: dict[int, PrivKey]) -> PrivKey:
     return int_to_privkey(
         shamir.combine_shares({i: privkey_to_int(s) for i, s in shares.items()}))

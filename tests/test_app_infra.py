@@ -1,0 +1,167 @@
+"""App-infrastructure tests: lifecycle ordering, featureset, retry,
+forkjoin, monitoring endpoints, peerinfo gossip, logging."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.app import featureset, log
+from charon_tpu.app.forkjoin import first_success, forkjoin
+from charon_tpu.app.lifecycle import Manager, StartOrder, StopOrder
+from charon_tpu.app.monitoring import MonitoringAPI, Registry
+from charon_tpu.app.peerinfo import PeerInfo
+from charon_tpu.app.retry import Retryer, backoff_delays
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.p2p.transport import Peer, TCPMesh
+from tests.test_p2p import free_ports
+
+
+def test_lifecycle_ordering():
+    async def main():
+        order = []
+        m = Manager()
+
+        def mk(name):
+            async def hook():
+                order.append(name)
+            return hook
+
+        m.register_start(StartOrder.SCHEDULER, "sched", mk("start:sched"))
+        m.register_start(StartOrder.TRACKER, "tracker", mk("start:tracker"))
+        m.register_stop(StopOrder.P2P, "p2p", mk("stop:p2p"))
+        m.register_stop(StopOrder.SCHEDULER, "sched", mk("stop:sched"))
+        task = asyncio.get_event_loop().create_task(m.run())
+        await asyncio.sleep(0.05)
+        m.stop()
+        await task
+        assert order == ["start:tracker", "start:sched",
+                         "stop:sched", "stop:p2p"]
+    asyncio.run(main())
+
+
+def test_featureset_gating():
+    featureset.init(featureset.Status.STABLE)
+    assert featureset.enabled("qbft_consensus")
+    assert not featureset.enabled("mock_alpha")
+    featureset.init(featureset.Status.ALPHA, disabled=["qbft_consensus"])
+    assert featureset.enabled("mock_alpha")
+    assert not featureset.enabled("qbft_consensus")
+    featureset.init()  # reset to defaults
+
+
+def test_retryer_retries_until_success():
+    async def main():
+        import time
+        r = Retryer(deadline_fn=lambda d: time.time() + 5)
+        attempts = []
+
+        async def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+
+        r.spawn("test", Duty(1, DutyType.ATTESTER), flaky)
+        await asyncio.sleep(1.0)
+        assert len(attempts) == 3
+        await r.shutdown()
+    asyncio.run(main())
+
+
+def test_retryer_abandons_at_deadline():
+    async def main():
+        import time
+        r = Retryer(deadline_fn=lambda d: time.time() + 0.3)
+        attempts = []
+
+        async def always_fails():
+            attempts.append(1)
+            raise RuntimeError("permanent")
+
+        r.spawn("test", Duty(1, DutyType.ATTESTER), always_fails)
+        await asyncio.sleep(1.0)
+        n = len(attempts)
+        await asyncio.sleep(0.3)
+        assert len(attempts) == n  # no more attempts after deadline
+        await r.shutdown()
+    asyncio.run(main())
+
+
+def test_backoff_is_exponential_and_capped():
+    g = backoff_delays(base=0.1, factor=2.0, jitter=0.0, max_delay=0.5)
+    delays = [next(g) for _ in range(5)]
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_forkjoin_and_first_success():
+    async def main():
+        async def double(x):
+            return 2 * x
+        assert await forkjoin([1, 2, 3], double) == [2, 4, 6]
+
+        async def fail():
+            raise RuntimeError("nope")
+
+        async def slow_ok():
+            await asyncio.sleep(0.1)
+            return "ok"
+        assert await first_success([fail, slow_ok]) == "ok"
+        with pytest.raises(RuntimeError):
+            await first_success([fail, fail])
+    asyncio.run(main())
+
+
+def test_monitoring_endpoints():
+    async def main():
+        reg = Registry(const_labels={"cluster_name": "test"})
+        reg.inc("duties_total", 3)
+        reg.set_gauge("peers_connected", 2)
+        ready = [False]
+        api = MonitoringAPI(reg, readyz=lambda: (ready[0], "not ready"),
+                            identity="node-0")
+        await api.start()
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", api.port)
+            w.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+            body = (await r.read()).decode()
+            assert 'duties_total{cluster_name="test"} 3.0' in body
+            assert 'peers_connected{cluster_name="test"} 2' in body
+
+            r, w = await asyncio.open_connection("127.0.0.1", api.port)
+            w.write(b"GET /readyz HTTP/1.0\r\n\r\n")
+            assert "503" in (await r.read()).decode()
+            ready[0] = True
+            r, w = await asyncio.open_connection("127.0.0.1", api.port)
+            w.write(b"GET /readyz HTTP/1.0\r\n\r\n")
+            assert "200" in (await r.read()).decode()
+        finally:
+            await api.stop()
+    asyncio.run(main())
+
+
+def test_peerinfo_gossip_and_lock_mismatch():
+    async def main():
+        ports = free_ports(2)
+        peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(2)]
+        m0 = TCPMesh(0, peers, b"s")
+        m1 = TCPMesh(1, peers, b"s")
+        await m0.start()
+        await m1.start()
+        try:
+            pi0 = PeerInfo(m0, "v1.0", lock_hash=b"\x01" * 32)
+            pi1 = PeerInfo(m1, "v0.9", lock_hash=b"\x02" * 32)  # mismatch
+            await pi0.poll_once()
+            assert pi0.peer_versions[1] == "v0.9"
+            assert 1 in pi0.lock_mismatches
+            assert abs(pi0.clock_skews[1]) < 1.0  # same host: tiny skew
+        finally:
+            await m0.stop()
+            await m1.stop()
+    asyncio.run(main())
+
+
+def test_log_formats(capsys):
+    log.init("logfmt", "info")
+    log.info("test", "hello", duty="5/attester")
+    err = capsys.readouterr().err
+    assert "msg=hello" in err and "duty=5/attester" in err
+    log.init("console", "info")

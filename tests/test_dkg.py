@@ -1,0 +1,131 @@
+"""DKG ceremony tests: keygen math units + a full 3-operator ceremony over
+real localhost TCP (reference analogue: dkg tests + compose DKG smoke)."""
+
+import asyncio
+import os
+
+import pytest
+
+from charon_tpu.cluster.definition import (Definition, Operator,
+                                           lock_from_json, load_json,
+                                           verify_lock)
+from charon_tpu.dkg import keygen
+from charon_tpu.dkg.ceremony import run_dkg
+from charon_tpu.eth2util import keystore
+from charon_tpu.tbls import api as tbls
+from tests.test_p2p import free_ports
+from charon_tpu.p2p.transport import Peer, TCPMesh
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def test_pedersen_keygen_math():
+    """2-round DKG without transport: shares verify, combine, and sign."""
+    n, t = 4, 3
+    r1 = {i: keygen.pedersen_round1(t, n) for i in range(1, n + 1)}
+    results = {}
+    for k in range(1, n + 1):
+        bcasts = {i: b for i, (b, _) in r1.items()}
+        shares = {i: s.shares[k] for i, (_, s) in r1.items()}
+        results[k] = keygen.pedersen_round2(k, n, bcasts, shares)
+
+    groups = {r.group_pubkey for r in results.values()}
+    assert len(groups) == 1  # everyone derives the same group key
+    # threshold-sign with t shares and verify against the group key
+    msg = b"pedersen-dkg-test"
+    psigs = {k: tbls.partial_sign(results[k].secret_share, msg)
+             for k in (1, 2, 4)}
+    sig = tbls.aggregate(psigs)
+    assert tbls.verify(results[1].group_pubkey, msg, sig)
+    # pubshares consistent across participants
+    assert results[1].pubshares == results[2].pubshares
+
+
+def test_pedersen_rejects_bad_share():
+    n, t = 3, 2
+    r1 = {i: keygen.pedersen_round1(t, n) for i in range(1, n + 1)}
+    bcasts = {i: b for i, (b, _) in r1.items()}
+    shares = {i: s.shares[1] for i, (_, s) in r1.items()}
+    shares[2] = tbls.int_to_privkey(12345)  # corrupt sender 2's share
+    with pytest.raises(ValueError, match="participant 2"):
+        keygen.pedersen_round2(1, n, bcasts, shares)
+
+
+def _run_ceremony(tmp_path, algorithm: str):
+    n, t, m = 3, 2, 2
+    ports = free_ports(n)
+    peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(n)]
+    definition = Definition(
+        name="test-cluster",
+        operators=tuple(Operator(address=f"0x{i:040x}",
+                                 enr=f"127.0.0.1:{ports[i]}")
+                        for i in range(n)),
+        threshold=t, num_validators=m, dkg_algorithm=algorithm)
+
+    async def main():
+        from charon_tpu.cluster.definition import definition_hash
+
+        secret = definition_hash(definition)  # frame auth from def hash
+        meshes = [TCPMesh(i, peers, secret) for i in range(n)]
+        for mesh in meshes:
+            await mesh.start()
+        try:
+            locks = await asyncio.gather(*(
+                run_dkg(definition, meshes[i], i,
+                        str(tmp_path / f"node{i}"))
+                for i in range(n)))
+            return locks
+        finally:
+            for mesh in meshes:
+                await mesh.stop()
+
+    return definition, asyncio.run(main())
+
+
+@pytest.mark.parametrize("algorithm", ["pedersen", "keycast"])
+def test_full_ceremony_over_tcp(tmp_path, algorithm):
+    definition, locks = _run_ceremony(tmp_path, algorithm)
+    n, t, m = 3, 2, 2
+
+    # all nodes computed the same, verifying lock
+    hashes = {l.lock_hash for l in locks}
+    assert len(hashes) == 1
+    for lock in locks:
+        verify_lock(lock)
+
+    # outputs on disk: lock json round-trips + keystores decrypt
+    for i in range(n):
+        obj = load_json(str(tmp_path / f"node{i}" / "cluster-lock.json"))
+        lock = lock_from_json(obj)
+        assert len(lock.validators) == m
+        keys = keystore.load_keys(str(tmp_path / f"node{i}" /
+                                      "validator_keys"))
+        assert len(keys) == m
+        # each stored share's pubkey matches the lock's pubshare for node i
+        for v, sk in enumerate(keys):
+            assert tbls.privkey_to_pubkey(sk) == \
+                lock.validators[v].public_shares[i]
+
+    # threshold-sign with shares recovered from two nodes' keystores
+    msg = b"post-dkg-duty"
+    sk0 = keystore.load_keys(str(tmp_path / "node0" / "validator_keys"))[0]
+    sk1 = keystore.load_keys(str(tmp_path / "node1" / "validator_keys"))[0]
+    sig = tbls.aggregate({1: tbls.partial_sign(sk0, msg),
+                          2: tbls.partial_sign(sk1, msg)})
+    assert tbls.verify(locks[0].validators[0].public_key, msg, sig)
+
+    # deposit data signatures verify
+    dep = load_json(str(tmp_path / "node0" / "deposit-data.json"))
+    assert len(dep) == m
+    from charon_tpu.eth2util.deposit import deposit_signing_root
+    for d, v in zip(dep, locks[0].validators):
+        root = deposit_signing_root(
+            bytes.fromhex(d["pubkey"]),
+            bytes.fromhex(d["withdrawal_credentials"]),
+            definition.fork_version)
+        assert tbls.verify(v.public_key, root, bytes.fromhex(d["signature"]))

@@ -1,0 +1,111 @@
+"""Tracker analysis + priority-protocol scoring/agreement tests
+(reference analogues: core/tracker tests, core/priority/prioritiser_test.go)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core.priority import (InfoSync, PriorityMsg, Prioritiser,
+                                      calculate_result)
+from charon_tpu.core.tracker import Step, Tracker
+from charon_tpu.core.types import (Duty, DutyType, ParSignedData,
+                                   SignedRandao, SlotTick)
+
+
+def psd(idx):
+    return ParSignedData(data=SignedRandao(epoch=0, signature=bytes(96)),
+                         share_idx=idx)
+
+
+def test_tracker_success_and_participation():
+    async def main():
+        tr = Tracker(num_peers=3, threshold=2)
+        duty = Duty(5, DutyType.ATTESTER)
+        await tr.on_duty_scheduled(duty, {})
+        await tr.on_fetched(duty, {})
+        await tr.on_consensus(duty, {})
+        await tr.on_parsig_internal(duty, {"pk": psd(1)})
+        await tr.on_parsig_external(duty, {"pk": psd(2)})
+        await tr.on_threshold(duty, "pk", [])
+        await tr.on_aggregated(duty, "pk", None)
+        report = await tr.analyse(duty)
+        assert report.success
+        assert report.participation == {1: True, 2: True, 3: False}
+        assert tr.participation_counts[1] == 1
+        assert tr.participation_counts[3] == 0
+    asyncio.run(main())
+
+
+def test_tracker_failure_root_cause():
+    async def main():
+        tr = Tracker(num_peers=3, threshold=2)
+        duty = Duty(6, DutyType.ATTESTER)
+        await tr.on_duty_scheduled(duty, {})
+        await tr.on_fetched(duty, {})
+        await tr.on_consensus(duty, {})
+        await tr.on_parsig_internal(duty, {"pk": psd(1)})
+        # no external sigs -> threshold never reached
+        report = await tr.analyse(duty)
+        assert not report.success
+        assert report.failed_step == Step.PARSIG_EX
+        assert "threshold" in report.reason or "broadcast" in report.reason
+    asyncio.run(main())
+
+
+def test_priority_scoring_quorum_and_order():
+    msgs = [
+        PriorityMsg(0, 1, (("proto", ("qbft/2", "qbft/1")),)),
+        PriorityMsg(1, 1, (("proto", ("qbft/2", "qbft/1")),)),
+        PriorityMsg(2, 1, (("proto", ("qbft/1",)),)),
+        PriorityMsg(3, 1, (("proto", ("legacy",)),)),
+    ]
+    [result] = calculate_result(msgs, quorum=3)
+    assert result.topic == "proto"
+    # qbft/1: count 3, qbft/2: count 2 < quorum, legacy: count 1 < quorum
+    assert result.priorities == ("qbft/1",)
+
+    # with quorum 2 both qbft versions survive; count dominates order
+    # (score = count·1000 − order), so qbft/1 (3 supporters) ranks first
+    [result] = calculate_result(msgs, quorum=2)
+    assert result.priorities == ("qbft/1", "qbft/2")
+
+
+def test_infosync_agreement_in_memory():
+    """3 peers exchange + 'consensus' via a shared in-memory bus; all agree
+    on the same protocol precedence."""
+    async def main():
+        inboxes = {i: [] for i in range(3)}
+        decided_subs = []
+        prios, infos = [], []
+
+        def mk_exchange(i):
+            async def exchange(msg):
+                inboxes[i].append(msg)
+                # simulate request/response with all peers: everyone offers
+                # the same version list in this test
+                return [PriorityMsg(p, msg.slot, msg.topics)
+                        for p in range(3)]
+            return exchange
+
+        async def propose(duty, value):
+            for fn in decided_subs:
+                await fn(duty, value)
+
+        def subscribe(fn):
+            decided_subs.append(fn)
+
+        for i in range(3):
+            p = Prioritiser(i, 3, mk_exchange(i), propose, subscribe)
+            prios.append(p)
+            infos.append(InfoSync(p, versions=["v1.0", "v0.9"],
+                                  protocols=["qbft/2", "qbft/1"]))
+
+        tick = SlotTick(slot=15, time=0.0, slot_duration=1.0,
+                        slots_per_epoch=16)
+        assert tick.last_in_epoch
+        await infos[0].on_slot(tick)
+        for info in infos:
+            assert info.protocols(20) == ["qbft/2", "qbft/1"]
+        # before any agreement, a fresh instance falls back to local prefs
+        assert infos[0].protocols(10) == ["qbft/2", "qbft/1"]
+    asyncio.run(main())
