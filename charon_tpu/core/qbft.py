@@ -52,7 +52,13 @@ class UponRule(IntEnum):
 @dataclass(frozen=True)
 class Msg:
     """One consensus message.  `value`/`prepared_value` must be hashable;
-    None is the zero value."""
+    None is the zero value.
+
+    `sig` is opaque to the algorithm: the p2p transport signs each outgoing
+    message with the node's identity key and verifies inbound ones —
+    including every message embedded in `justification`, which peers relay
+    and could otherwise forge (reference: core/consensus/component.go:343-353
+    ECDSA-signs/verifies messages the same way)."""
 
     type: MsgType
     instance: Any
@@ -62,6 +68,14 @@ class Msg:
     prepared_round: int = 0
     prepared_value: Any = None
     justification: tuple = ()
+    sig: bytes = b""
+
+    def signing_payload(self) -> "Msg":
+        """The message with signature and justification stripped — what the
+        identity signature covers (justification entries carry their own
+        signatures)."""
+        return Msg(self.type, self.instance, self.source, self.round,
+                   self.value, self.prepared_round, self.prepared_value)
 
 
 @dataclass

@@ -31,6 +31,7 @@ from . import keygen
 
 SYNC_PROTOCOL = "/charon_tpu/dkg/sync/1.0.0"
 ROUND1_PROTOCOL = "/charon_tpu/dkg/round1/1.0.0"
+ECHO_PROTOCOL = "/charon_tpu/dkg/echo/1.0.0"
 KEYCAST_PROTOCOL = "/charon_tpu/dkg/keycast/1.0.0"
 LOCKSIG_PROTOCOL = "/charon_tpu/dkg/lock_sig/1.0.0"
 
@@ -54,12 +55,15 @@ class Ceremony:
         self._sync_evt = asyncio.Event()
         self._round1: dict[int, dict] = {}   # sender -> payload
         self._round1_evt = asyncio.Event()
+        self._echoes: dict[int, dict] = {}   # sender -> {dealer: digest hex}
+        self._echo_evt = asyncio.Event()
         self._keycast: dict | None = None
         self._keycast_evt = asyncio.Event()
         self._lock_sigs: dict[int, list] = {index: []}
         self._locksig_evt = asyncio.Event()
         mesh.register_handler(SYNC_PROTOCOL, self._on_sync)
         mesh.register_handler(ROUND1_PROTOCOL, self._on_round1)
+        mesh.register_handler(ECHO_PROTOCOL, self._on_echo)
         mesh.register_handler(KEYCAST_PROTOCOL, self._on_keycast)
         mesh.register_handler(LOCKSIG_PROTOCOL, self._on_locksig)
 
@@ -76,6 +80,12 @@ class Ceremony:
         self._round1[sender] = decode_json(payload)
         if len(self._round1) == self.n - 1:
             self._round1_evt.set()
+        return None
+
+    async def _on_echo(self, sender: int, payload: bytes):
+        self._echoes[sender] = decode_json(payload)
+        if len(self._echoes) == self.n - 1:
+            self._echo_evt.set()
         return None
 
     async def _on_keycast(self, sender: int, payload: bytes):
@@ -128,6 +138,7 @@ class Ceremony:
                                        encode_json(payload))
         if self.n > 1:
             await asyncio.wait_for(self._round1_evt.wait(), timeout)
+            await self._echo_commitments(my_bcasts, timeout)
 
         # Round 2: verify + combine per validator.
         results = []
@@ -141,6 +152,35 @@ class Ceremony:
             results.append(keygen.pedersen_round2(
                 self.share_idx, self.n, bcasts, shares))
         return results
+
+    async def _echo_commitments(self, my_bcasts, timeout: float) -> None:
+        """Reliable-broadcast check on round-1 Feldman commitments: every
+        peer echoes a per-dealer digest of the commitments it received; a
+        dealer who equivocated (sent different commitments to different
+        peers) is identified by digest mismatch and the ceremony aborts
+        naming them.  (The reference gets this property from FROST's
+        broadcast-round assumptions; round-1 advisor finding.)"""
+        import hashlib
+
+        def digest(commitments) -> str:
+            blob = encode_json(commitments)
+            return hashlib.sha256(blob).hexdigest()
+
+        mine: dict[str, str] = {
+            str(self.index): digest([[c.hex() for c in b.commitments]
+                                     for b in my_bcasts])}
+        for sender, payload in self._round1.items():
+            mine[str(sender)] = digest(payload["commitments"])
+        await asyncio.gather(*(
+            self.mesh.send_async(peer, ECHO_PROTOCOL, encode_json(mine))
+            for peer in self.mesh.peers))
+        await asyncio.wait_for(self._echo_evt.wait(), timeout)
+        for sender, seen in self._echoes.items():
+            for dealer, dig in seen.items():
+                if dealer in mine and dig != mine[dealer]:
+                    raise ValueError(
+                        f"dealer {dealer} equivocated round-1 commitments "
+                        f"(digest mismatch reported by peer {sender})")
 
     async def run_keycast(self, timeout: float = 60.0) -> list[keygen.KeygenResult]:
         """Operator 0 deals (reference: dkg/keycast.go leader)."""

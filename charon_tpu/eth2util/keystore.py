@@ -27,7 +27,11 @@ def _scrypt(password: bytes, salt: bytes, n: int) -> bytes:
 
 def encrypt(secret: bytes, password: str, *,
             insecure: bool = True) -> dict:
-    """Encrypt a 32-byte BLS secret into an EIP-2335 keystore dict."""
+    """Encrypt a 32-byte BLS secret into an EIP-2335 keystore dict.
+
+    Includes the EIP-2335 `path` and `pubkey` fields standard validator
+    clients require on import (reference: eth2util/keystore/
+    keystore.go:139-172 writes both; round-1 advisor finding)."""
     salt = secrets.token_bytes(32)
     iv = secrets.token_bytes(16)
     n = SCRYPT_N_INSECURE if insecure else SCRYPT_N_STANDARD
@@ -35,7 +39,11 @@ def encrypt(secret: bytes, password: str, *,
     cipher = Cipher(algorithms.AES(dk[:16]), modes.CTR(iv)).encryptor()
     ct = cipher.update(secret) + cipher.finalize()
     checksum = hashlib.sha256(dk[16:32] + ct).digest()
+    from ..tbls import api as _tbls
+
     return {
+        "path": "m/12381/3600/0/0/0",  # EIP-2334 signing-key path
+        "pubkey": _tbls.privkey_to_pubkey(secret).hex(),
         "crypto": {
             "kdf": {"function": "scrypt",
                     "params": {"dklen": 32, "n": n, "r": 8, "p": 1,

@@ -1,0 +1,338 @@
+"""Batched ZCash point (de)serialisation for BLS12-381 — bytes on the host,
+square roots on the device.
+
+The reference deserialises each 96-byte compressed signature one at a time
+on the CPU (kryptology, consumed via tbls/tblsconv/tblsconv.go:29-173).
+Here the whole validator batch crosses the host↔device boundary as flat
+byte arrays: the host does only a vectorised numpy bit-shuffle
+(bytes ↔ 12-bit limb planes, no per-element Python), and the expensive part
+of decompression — recovering y as a square root in Fp/Fp2 — runs on device
+as fixed-exponent pow chains, batched over all points:
+
+- Fp  sqrt: a^((p+1)/4)                       (p ≡ 3 mod 4)
+- Fp2 sqrt: Adj–Rodríguez-Henríquez Alg. 9    (two ~381-bit pows)
+
+This makes `tbls.threshold_combine` / `batch_verify` honest bytes-in →
+bytes-out device pipelines (BASELINE.md north star) with no Python loop over
+validators anywhere on the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import fp, tower
+from . import curve as jcurve
+from .curve import FP_OPS, F2_OPS, from_affine, to_affine
+from ..tbls.ref import curve as refcurve
+from ..tbls.ref.fields import BLS_X, FQ2, P, R
+
+# ---------------------------------------------------------------------------
+# Host-side vectorised byte ↔ limb conversion (numpy only, no Python loops)
+# ---------------------------------------------------------------------------
+
+_C_FLAG, _I_FLAG, _S_FLAG = 0x80, 0x40, 0x20
+_P_LIMBS = fp.to_limbs(P)
+_HALF_LIMBS = fp.to_limbs((P - 1) // 2)  # sgn(v): v > (p-1)/2
+_W12 = (1 << np.arange(fp.LIMB_BITS, dtype=np.int64)).astype(np.int32)
+
+
+def bytes48_to_limbs(raw: np.ndarray) -> np.ndarray:
+    """[..., 48] uint8 big-endian → [..., 32] int32 little-endian 12-bit limbs."""
+    bits_be = np.unpackbits(raw, axis=-1)
+    bits_le = bits_be[..., ::-1]
+    shaped = bits_le.reshape(*raw.shape[:-1], fp.NLIMBS, fp.LIMB_BITS)
+    return (shaped.astype(np.int32) * _W12).sum(-1, dtype=np.int32)
+
+
+def limbs_to_bytes48(limbs: np.ndarray) -> np.ndarray:
+    """[..., 32] int32 limbs → [..., 48] uint8 big-endian."""
+    bits_le = ((limbs[..., :, None] >> np.arange(fp.LIMB_BITS)) & 1).astype(
+        np.uint8)
+    bits_be = bits_le.reshape(*limbs.shape[:-1], 48 * 8)[..., ::-1]
+    return np.packbits(bits_be, axis=-1)
+
+
+def _limbs_cmp_const(a: np.ndarray, c: np.ndarray) -> np.ndarray:
+    """Lexicographic sign of (a − c) for a [..., 32] batch vs constant c:
+    returns −1 / 0 / +1 per row, fully vectorised."""
+    neq = a != c
+    # most-significant differing limb (little-endian storage ⇒ reverse scan)
+    idx = (fp.NLIMBS - 1) - np.argmax(neq[..., ::-1], axis=-1)
+    picked_a = np.take_along_axis(a, idx[..., None], -1)[..., 0]
+    picked_c = c[idx]
+    out = np.sign(picked_a - picked_c)
+    out[~neq.any(-1)] = 0
+    return out
+
+
+def limbs_lt_p(a: np.ndarray) -> np.ndarray:
+    return _limbs_cmp_const(a, _P_LIMBS) < 0
+
+
+def limbs_sgn(a: np.ndarray) -> np.ndarray:
+    """ZCash lexicographic sign of a standard-form Fp element: a > (p−1)/2."""
+    return _limbs_cmp_const(a, _HALF_LIMBS) > 0
+
+
+def g1_bytes_split(raw: np.ndarray):
+    """[N, 48] uint8 → (x_limbs [N,32], sign [N], inf [N], bad [N])."""
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    flags = raw[:, 0]
+    c, i, s = (flags & _C_FLAG) != 0, (flags & _I_FLAG) != 0, (flags & _S_FLAG) != 0
+    data = raw.copy()
+    data[:, 0] &= 0x1F
+    x = bytes48_to_limbs(data)
+    bad = ~c
+    bad |= i & (s | (x != 0).any(-1))
+    bad |= ~i & ~limbs_lt_p(x)
+    return x, s, i, bad
+
+
+def g2_bytes_split(raw: np.ndarray):
+    """[N, 96] uint8 → (xc0, xc1 [N,32], sign [N], inf [N], bad [N])."""
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    flags = raw[:, 0]
+    c, i, s = (flags & _C_FLAG) != 0, (flags & _I_FLAG) != 0, (flags & _S_FLAG) != 0
+    hi = raw[:, :48].copy()
+    hi[:, 0] &= 0x1F
+    xc1 = bytes48_to_limbs(hi)
+    xc0 = bytes48_to_limbs(raw[:, 48:])
+    bad = ~c
+    bad |= i & (s | (xc1 != 0).any(-1) | (xc0 != 0).any(-1))
+    bad |= ~i & ~(limbs_lt_p(xc0) & limbs_lt_p(xc1))
+    return xc0, xc1, s, i, bad
+
+
+def g1_assemble(x_std: np.ndarray, y_sgn: np.ndarray,
+                inf: np.ndarray) -> np.ndarray:
+    """Standard-form affine x limbs + y sign + inf → [N, 48] uint8 compressed."""
+    out = limbs_to_bytes48(x_std)
+    out[:, 0] |= _C_FLAG | np.where(y_sgn, _S_FLAG, 0).astype(np.uint8)
+    out[inf] = 0
+    out[inf, 0] = _C_FLAG | _I_FLAG
+    return out
+
+
+def g2_assemble(xc0_std: np.ndarray, xc1_std: np.ndarray, y_sgn: np.ndarray,
+                inf: np.ndarray) -> np.ndarray:
+    out = np.concatenate([limbs_to_bytes48(xc1_std), limbs_to_bytes48(xc0_std)],
+                         axis=-1)
+    out[:, 0] |= _C_FLAG | np.where(y_sgn, _S_FLAG, 0).astype(np.uint8)
+    out[inf] = 0
+    out[inf, 0] = _C_FLAG | _I_FLAG
+    return out
+
+
+def fp2_sgn_np(c0_std: np.ndarray, c1_std: np.ndarray) -> np.ndarray:
+    """Vectorised ZCash sign of an Fp2 value from standard-form limb planes."""
+    c1_zero = (c1_std == 0).all(-1)
+    return np.where(c1_zero, limbs_sgn(c0_std), limbs_sgn(c1_std))
+
+
+# ---------------------------------------------------------------------------
+# Device square roots
+# ---------------------------------------------------------------------------
+
+def fp_sqrt(a_m: jnp.ndarray):
+    """Batched Fp square root (Montgomery in/out).  p ≡ 3 mod 4 ⇒ candidate
+    a^((p+1)/4).  Returns (root, ok); root is garbage where ok is False."""
+    root = fp.pow_fixed(a_m, (P + 1) // 4)
+    ok = fp.eq(fp.sqr(root), a_m)
+    return root, ok
+
+
+_F2_MINUS_ONE_M = np.stack([fp.to_limbs((P - 1) * fp.R_MONT % P), fp.ZERO])
+
+
+def f2_sqrt(a_m: jnp.ndarray):
+    """Batched Fp2 square root, Alg. 9 of Adj & Rodríguez-Henríquez
+    ("Square root computation over even extension fields", 2012) for
+    q = p², p ≡ 3 mod 4 — two fixed-exponent pows, fully branch-free:
+
+        a1 = a^((p−3)/4);  α = a1²·a;  x0 = a1·a
+        α = −1 → root = u·x0;  else → root = (α+1)^((p−1)/2) · x0
+    """
+    a1 = tower.f2_pow_fixed(a_m, (P - 3) // 4)
+    alpha = tower.f2_mul(tower.f2_sqr(a1), a_m)
+    x0 = tower.f2_mul(a1, a_m)
+    # branch 1: α == −1 ⇒ root = u·x0 = (−x0c1) + x0c0·u
+    root_u = tower.f2(fp.neg(x0[..., 1, :]), x0[..., 0, :])
+    # branch 2: root = (α+1)^((p−1)/2) · x0
+    b = tower.f2_pow_fixed(
+        tower.f2_add(alpha, jnp.asarray(tower.F2_ONE_M)), (P - 1) // 2)
+    root_b = tower.f2_mul(b, x0)
+    is_m1 = tower.f2_eq(alpha, jnp.asarray(_F2_MINUS_ONE_M))
+    root = tower.f2_select(is_m1, root_u, root_b)
+    ok = tower.f2_eq(tower.f2_sqr(root), a_m)
+    return root, ok
+
+
+# ---------------------------------------------------------------------------
+# Subgroup membership checks
+#
+# The CPU oracle deserialiser enforces prime-order subgroup membership
+# (ref/curve.py g2_from_bytes, reference kryptology does the same); the
+# device paths must match or a byzantine peer could slip a cofactor
+# component past verification (pairing final exponentiation annihilates it)
+# and poison the aggregate.
+#
+# G2 uses the ψ-endomorphism check: Q ∈ G2  ⟺  ψ(Q) = [z]Q  where z is the
+# BLS parameter and ψ(x, y) = (c_x·x̄ᵖ, c_y·ȳᵖ) (untwist-Frobenius-twist).
+# One 64-bit scalar-mul instead of a 255-bit one.  The constants and the
+# sign of z are DERIVED from the oracle at import and verified on random
+# subgroup points and on a cofactor point — nothing is trusted from memory.
+#
+# G1 uses the full-order check [r]P = ∞ (E(Fp)[r] is exactly G1).
+# ---------------------------------------------------------------------------
+
+def _derive_psi_constants():
+    g = refcurve.G2_GEN
+    cofactor_pt = _find_g2_cofactor_point()
+    for z_signed in (-BLS_X, BLS_X):
+        target = refcurve.multiply(g, z_signed % R)
+        cx = target[0] / g[0].frobenius()
+        cy = target[1] / g[1].frobenius()
+
+        def psi(q):
+            return (cx * q[0].frobenius(), cy * q[1].frobenius())
+
+        ok = all(
+            psi(q) == refcurve.multiply(q, z_signed % R)
+            for q in (refcurve.multiply(g, 12345),
+                      refcurve.multiply(g, 2**200 + 7)))
+        if ok and psi(cofactor_pt) != refcurve.multiply(
+                cofactor_pt, z_signed % R):
+            return cx, cy, z_signed
+    raise AssertionError("could not derive a valid psi-endomorphism check")
+
+
+def _find_g2_cofactor_point():
+    """An on-curve E'(Fp2) point NOT in the r-order subgroup."""
+    x = 1
+    while True:
+        xf = FQ2([x, 0])
+        y = (xf * xf * xf + refcurve.B2).sqrt()
+        if y is not None:
+            pt = (xf, y)
+            if refcurve.multiply_raw(pt, R) is not None:
+                return pt
+        x += 1
+
+
+_PSI_CX, _PSI_CY, _Z_SIGNED = _derive_psi_constants()
+_PSI_CX_M = tower.f2_pack([_PSI_CX])[0]
+_PSI_CY_M = tower.f2_pack([_PSI_CY])[0]
+_ABS_Z_BITS = np.array([(abs(_Z_SIGNED) >> (63 - i)) & 1 for i in range(64)],
+                       np.int32)
+_R_BITS = np.array([(R >> (254 - i)) & 1 for i in range(255)], np.int32)
+
+
+def g2_psi(pt: jnp.ndarray) -> jnp.ndarray:
+    """ψ on Jacobian coords: (c_x·X̄ᵖ, c_y·Ȳᵖ, Z̄ᵖ) — Frobenius commutes with
+    the Jacobian scaling since the constants absorb the weight factors."""
+    x, y, z = jcurve._coords(F2_OPS, pt)
+    return jcurve.make_point(
+        F2_OPS,
+        tower.f2_mul(jnp.asarray(_PSI_CX_M), tower.f2_conj(x)),
+        tower.f2_mul(jnp.asarray(_PSI_CY_M), tower.f2_conj(y)),
+        tower.f2_conj(z))
+
+
+def g2_in_subgroup(pt: jnp.ndarray) -> jnp.ndarray:
+    """Batched ψ(Q) == [z]Q check (True at ∞)."""
+    batch = pt.shape[:-3]
+    bits = jnp.broadcast_to(jnp.asarray(_ABS_Z_BITS), batch + (64,))
+    zq = jcurve.scalar_mul(F2_OPS, pt, bits)
+    if _Z_SIGNED < 0:
+        zq = jcurve.neg_point(F2_OPS, zq)
+    return jcurve.eq_points(F2_OPS, g2_psi(pt), zq)
+
+
+def g1_in_subgroup(pt: jnp.ndarray) -> jnp.ndarray:
+    """Batched [r]P == ∞ check."""
+    batch = pt.shape[:-2]
+    bits = jnp.broadcast_to(jnp.asarray(_R_BITS), batch + (255,))
+    rp = jcurve.scalar_mul(FP_OPS, pt, bits)
+    return jcurve.is_inf(FP_OPS, rp)
+
+
+# ---------------------------------------------------------------------------
+# Device decompression: x limb planes (standard form) → Jacobian points
+# ---------------------------------------------------------------------------
+
+def g1_decompress(x_std: jnp.ndarray, sign: jnp.ndarray, inf: jnp.ndarray,
+                  subgroup_check: bool = True):
+    """[..., 32] std-form x + sign/inf flags → (Jacobian [..., 3, 32], ok).
+    Checks on-curve (sqrt fails for non-residue rhs) and, by default,
+    prime-order subgroup membership — matching the oracle deserialiser
+    (ref/curve.py g1_from_bytes, reference tblsconv semantics)."""
+    x_m = fp.to_mont(x_std)
+    rhs = fp.add(fp.mul(fp.sqr(x_m), x_m), jnp.asarray(np.asarray(FP_OPS.b_m)))
+    y_m, ok = fp_sqrt(rhs)
+    flip = limbs_sgn_device(fp.from_mont(y_m)) != sign
+    y_m = fp.select(flip, fp.neg(y_m), y_m)
+    pt = from_affine(FP_OPS, x_m, y_m, inf=inf)
+    ok = ok | inf
+    if subgroup_check:
+        ok = ok & g1_in_subgroup(pt)
+    return pt, ok
+
+
+def g2_decompress(xc0_std: jnp.ndarray, xc1_std: jnp.ndarray,
+                  sign: jnp.ndarray, inf: jnp.ndarray,
+                  subgroup_check: bool = True):
+    """Std-form x = c0 + c1·u limb planes → (Jacobian [..., 3, 2, 32], ok)."""
+    x_m = tower.f2(fp.to_mont(xc0_std), fp.to_mont(xc1_std))
+    rhs = tower.f2_add(tower.f2_mul(tower.f2_sqr(x_m), x_m),
+                       jnp.asarray(np.asarray(F2_OPS.b_m)))
+    y_m, ok = f2_sqrt(rhs)
+    y0_std = fp.from_mont(y_m[..., 0, :])
+    y1_std = fp.from_mont(y_m[..., 1, :])
+    cur = jnp.where(fp.is_zero(y1_std),
+                    limbs_sgn_device(y0_std), limbs_sgn_device(y1_std))
+    y_m = tower.f2_select(cur != sign, tower.f2_neg(y_m), y_m)
+    pt = from_affine(F2_OPS, x_m, y_m, inf=inf)
+    ok = ok | inf
+    if subgroup_check:
+        ok = ok & g2_in_subgroup(pt)
+    return pt, ok
+
+
+def limbs_sgn_device(a_std: jnp.ndarray) -> jnp.ndarray:
+    """Device ZCash sign: a > (p−1)/2 via borrow of a − ((p+1)/2)."""
+    return fp.sgn(a_std)
+
+
+# ---------------------------------------------------------------------------
+# Device normalisation (the device half of compression)
+# ---------------------------------------------------------------------------
+
+def g1_normalize(pt_jac: jnp.ndarray):
+    """Jacobian Montgomery → (x_std, y_std, inf) limb planes for g1_assemble."""
+    x, y, inf = to_affine(FP_OPS, pt_jac)
+    return fp.from_mont(x), fp.from_mont(y), inf
+
+
+def g2_normalize(pt_jac: jnp.ndarray):
+    """Jacobian Montgomery → (xc0, xc1, yc0, yc1 std, inf)."""
+    x, y, inf = to_affine(F2_OPS, pt_jac)
+    return (fp.from_mont(x[..., 0, :]), fp.from_mont(x[..., 1, :]),
+            fp.from_mont(y[..., 0, :]), fp.from_mont(y[..., 1, :]), inf)
+
+
+# ---------------------------------------------------------------------------
+# Host round-trip conveniences (bytes → device → bytes), used by the backend
+# ---------------------------------------------------------------------------
+
+def g2_compress_np(xc0, xc1, yc0, yc1, inf) -> np.ndarray:
+    """numpy std-form affine limb planes → [N, 96] uint8 compressed."""
+    sgn = fp2_sgn_np(np.asarray(yc0), np.asarray(yc1))
+    return g2_assemble(np.asarray(xc0), np.asarray(xc1), sgn, np.asarray(inf))
+
+
+def g1_compress_np(x, y, inf) -> np.ndarray:
+    sgn = limbs_sgn(np.asarray(y))
+    return g1_assemble(np.asarray(x), sgn, np.asarray(inf))

@@ -47,6 +47,8 @@ class FieldOps:
     is_zero: Callable
     eq: Callable
     select: Callable
+    mul_many: Callable   # batched independent products — one multiplier call
+    sqr_many: Callable
     one_m: Any   # Montgomery 1 constant (numpy)
     b_m: Any     # curve coefficient b in Montgomery form (numpy)
 
@@ -56,6 +58,7 @@ FP_OPS = FieldOps(
     add=fp.add, sub=fp.sub, neg=fp.neg, mul=fp.mul, sqr=fp.sqr,
     dbl=fp.double, mul_small=fp.mul_small, inv=fp.inv,
     is_zero=fp.is_zero, eq=fp.eq, select=fp.select,
+    mul_many=fp.mul_many, sqr_many=fp.sqr_many,
     one_m=fp.ONE_M,
     b_m=fp.to_limbs(4 * fp.R_MONT % P),
 )
@@ -66,6 +69,7 @@ F2_OPS = FieldOps(
     sqr=tower.f2_sqr, dbl=tower.f2_double, mul_small=tower.f2_mul_small,
     inv=tower.f2_inv, is_zero=tower.f2_is_zero, eq=tower.f2_eq,
     select=tower.f2_select,
+    mul_many=tower.f2_mul_many, sqr_many=tower.f2_sqr_many,
     one_m=tower.F2_ONE_M,
     b_m=tower.f2_pack([FQ2([4, 4])])[0],  # twist: y² = x³ + 4(u+1)
 )
@@ -118,39 +122,39 @@ def neg_point(F: FieldOps, pt):
 
 
 def double_point(F: FieldOps, pt):
-    """dbl-2009-l (a = 0).  Z=0 (infinity) maps to Z3 = 0 automatically."""
+    """dbl-2009-l (a = 0).  Z=0 (infinity) maps to Z3 = 0 automatically.
+    Independent products grouped into 4 batched multiplier calls."""
     x1, y1, z1 = _coords(F, pt)
-    a = F.sqr(x1)
-    b = F.sqr(y1)
-    c = F.sqr(b)
-    d = F.dbl(F.sub(F.sub(F.sqr(F.add(x1, b)), a), c))
+    a, b = F.sqr_many([x1, y1])
+    c, s2 = F.sqr_many([b, F.add(x1, b)])
+    d = F.dbl(F.sub(F.sub(s2, a), c))
     e = F.mul_small(a, 3)
-    f = F.sqr(e)
+    f, yz = F.mul_many([(e, e), (y1, z1)])
     x3 = F.sub(f, F.dbl(d))
-    y3 = F.sub(F.mul(e, F.sub(d, x3)), F.mul_small(c, 8))
-    z3 = F.dbl(F.mul(y1, z1))
+    [m] = F.mul_many([(e, F.sub(d, x3))])
+    y3 = F.sub(m, F.mul_small(c, 8))
+    z3 = F.dbl(yz)
     return make_point(F, x3, y3, z3)
 
 
 def add_points(F: FieldOps, p1, p2):
     """Complete addition: add-2007-bl with select-resolved exceptional cases
-    (P=Q → doubling; P=−Q → ∞ falls out of the formula; P or Q = ∞)."""
+    (P=Q → doubling; P=−Q → ∞ falls out of the formula; P or Q = ∞).
+    Independent products grouped into 6 batched multiplier calls."""
     x1, y1, z1 = _coords(F, p1)
     x2, y2, z2 = _coords(F, p2)
-    z1z1 = F.sqr(z1)
-    z2z2 = F.sqr(z2)
-    u1 = F.mul(x1, z2z2)
-    u2 = F.mul(x2, z1z1)
-    s1 = F.mul(F.mul(y1, z2), z2z2)
-    s2 = F.mul(F.mul(y2, z1), z1z1)
+    z1z1, z2z2 = F.sqr_many([z1, z2])
+    u1, u2, y1z2, y2z1 = F.mul_many(
+        [(x1, z2z2), (x2, z1z1), (y1, z2), (y2, z1)])
+    s1, s2 = F.mul_many([(y1z2, z2z2), (y2z1, z1z1)])
     h = F.sub(u2, u1)
-    i = F.sqr(F.dbl(h))
-    j = F.mul(h, i)
     r = F.dbl(F.sub(s2, s1))
-    v = F.mul(u1, i)
-    x3 = F.sub(F.sub(F.sqr(r), j), F.dbl(v))
-    y3 = F.sub(F.mul(r, F.sub(v, x3)), F.dbl(F.mul(s1, j)))
-    z3 = F.mul(F.sub(F.sub(F.sqr(F.add(z1, z2)), z1z1), z2z2), h)
+    i, r2, zz = F.sqr_many([F.dbl(h), r, F.add(z1, z2)])
+    j, v = F.mul_many([(h, i), (u1, i)])
+    x3 = F.sub(F.sub(r2, j), F.dbl(v))
+    t1, t2, z3 = F.mul_many(
+        [(r, F.sub(v, x3)), (s1, j), (F.sub(F.sub(zz, z1z1), z2z2), h)])
+    y3 = F.sub(t1, F.dbl(t2))
     raw = make_point(F, x3, y3, z3)
 
     same = F.is_zero(h) & F.is_zero(r)  # P == Q (in the group sense)
@@ -173,10 +177,12 @@ def eq_points(F: FieldOps, p1, p2):
     """Group-element equality across different Jacobian representatives."""
     x1, y1, z1 = _coords(F, p1)
     x2, y2, z2 = _coords(F, p2)
-    z1z1 = F.sqr(z1)
-    z2z2 = F.sqr(z2)
-    ex = F.eq(F.mul(x1, z2z2), F.mul(x2, z1z1))
-    ey = F.eq(F.mul(F.mul(y1, z2), z2z2), F.mul(F.mul(y2, z1), z1z1))
+    z1z1, z2z2 = F.sqr_many([z1, z2])
+    xa, xb, ya, yb = F.mul_many(
+        [(x1, z2z2), (x2, z1z1), (y1, z2), (y2, z1)])
+    ya2, yb2 = F.mul_many([(ya, z2z2), (yb, z1z1)])
+    ex = F.eq(xa, xb)
+    ey = F.eq(ya2, yb2)
     i1, i2 = F.is_zero(z1), F.is_zero(z2)
     return (i1 & i2) | (~i1 & ~i2 & ex & ey)
 
@@ -198,25 +204,26 @@ SCALAR_BITS = 256
 
 
 def scalars_to_bits(scalars) -> np.ndarray:
-    """Host: list of ints (mod R) → [len, 256] int32 bit planes, MSB first."""
-    out = np.zeros((len(scalars), SCALAR_BITS), np.int32)
-    for n, s in enumerate(scalars):
-        s = int(s) % R
-        for i in range(SCALAR_BITS):
-            out[n, i] = (s >> (SCALAR_BITS - 1 - i)) & 1
-    return out
+    """Host: list of ints (mod R) → [len, 256] int32 bit planes, MSB first.
+    Vectorised: one 32-byte conversion per scalar, then a single unpackbits."""
+    raw = np.stack([
+        np.frombuffer((int(s) % R).to_bytes(32, "big"), np.uint8)
+        for s in scalars])
+    return np.unpackbits(raw, axis=-1).astype(np.int32)
 
 
 def scalar_mul(F: FieldOps, pt, bits):
     """Batched double-and-add, MSB-first.  `pt` [..., 3, elem], `bits`
-    [..., 256] int32.  Constant trip count, branch-free: XLA-friendly."""
+    [..., nbits] int32 (any static bit width — 256 for full scalars, 64 for
+    the BLS-parameter multiplications in subgroup checks).  Constant trip
+    count, branch-free: XLA-friendly."""
 
     def body(i, acc):
         acc = double_point(F, acc)
         added = add_points(F, acc, pt)
         return point_select(F, bits[..., i] == 1, added, acc)
 
-    return lax.fori_loop(0, SCALAR_BITS, body,
+    return lax.fori_loop(0, bits.shape[-1], body,
                          inf_point(F, pt.shape[: pt.ndim - (F.elem_ndim + 1)]))
 
 

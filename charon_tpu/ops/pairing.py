@@ -50,20 +50,22 @@ def _dbl_step(X, Y, Z):
     Line ℓ through 2·R evaluated at P, scaled by 2YZ²:
         c0 = 2Y²Z − 3X³, c1 = 3X²Z·xP, c4 = −2YZ²·yP
     (c1/c4 bases returned; the xP/−yP scaling happens in `_ell`).
+    Independent products grouped into 4 batched multiplier calls.
     """
-    XX = f2_sqr(X)
-    YY = f2_sqr(Y)
+    from .tower import f2_mul_many
+
+    XX, YY, s, XY = f2_mul_many([(X, X), (Y, Y), (Y, Z), (X, Y)])
     w = f2_mul_small(XX, 3)            # 3X²
-    s = f2_mul(Y, Z)                   # YZ
-    B = f2_mul(f2_mul(X, Y), s)        # XY²Z
-    h = f2_sub(f2_sqr(w), f2_mul_small(B, 8))
-    X3 = f2_mul_small(f2_mul(h, s), 2)
-    Y3 = f2_sub(f2_mul(w, f2_sub(f2_mul_small(B, 4), h)),
-                f2_mul_small(f2_mul(YY, f2_sqr(s)), 8))
-    Z3 = f2_mul_small(f2_mul(s, f2_sqr(s)), 8)
-    c0 = f2_sub(f2_mul_small(f2_mul(YY, Z), 2), f2_mul(w, X))
-    c1b = f2_mul(w, Z)                 # × xP
-    c4b = f2_mul_small(f2_mul(s, Z), 2)  # × (−yP)
+    ss, B, c1b, wX, YYZ, sZ = f2_mul_many(
+        [(s, s), (XY, s), (w, Z), (w, X), (YY, Z), (s, Z)])
+    wsq, YYss, sss = f2_mul_many([(w, w), (YY, ss), (s, ss)])
+    h = f2_sub(wsq, f2_mul_small(B, 8))
+    hs, wterm = f2_mul_many([(h, s), (w, f2_sub(f2_mul_small(B, 4), h))])
+    X3 = f2_mul_small(hs, 2)
+    Y3 = f2_sub(wterm, f2_mul_small(YYss, 8))
+    Z3 = f2_mul_small(sss, 8)
+    c0 = f2_sub(f2_mul_small(YYZ, 2), wX)
+    c4b = f2_mul_small(sZ, 2)          # × (−yP)
     return (X3, Y3, Z3), c0, c1b, c4b
 
 
@@ -71,19 +73,21 @@ def _add_step(X1, Y1, Z1, x2, y2):
     """Mixed addition R + Q (Q affine) + line coeffs, scaled by δ:
         θ = Y1 − y2·Z1, δ = X1 − x2·Z1
         c0 = δ·y2 − θ·x2, c1 = θ·xP, c4 = −δ·yP
+    Independent products grouped into 4 batched multiplier calls.
     """
-    theta = f2_sub(Y1, f2_mul(y2, Z1))
-    delta = f2_sub(X1, f2_mul(x2, Z1))
-    c = f2_sqr(theta)
-    d = f2_sqr(delta)
-    e = f2_mul(delta, d)
-    f_ = f2_mul(Z1, c)
-    g = f2_mul(X1, d)
+    from .tower import f2_mul_many
+
+    yZ, xZ = f2_mul_many([(y2, Z1), (x2, Z1)])
+    theta = f2_sub(Y1, yZ)
+    delta = f2_sub(X1, xZ)
+    c, d, dy, tx = f2_mul_many(
+        [(theta, theta), (delta, delta), (delta, y2), (theta, x2)])
+    e, f_, g = f2_mul_many([(delta, d), (Z1, c), (X1, d)])
     h = f2_sub(f2_add(e, f_), f2_mul_small(g, 2))
-    X3 = f2_mul(delta, h)
-    Y3 = f2_sub(f2_mul(theta, f2_sub(g, h)), f2_mul(e, Y1))
-    Z3 = f2_mul(Z1, e)
-    c0 = f2_sub(f2_mul(delta, y2), f2_mul(theta, x2))
+    X3, t, eY, Z3 = f2_mul_many(
+        [(delta, h), (theta, f2_sub(g, h)), (e, Y1), (Z1, e)])
+    Y3 = f2_sub(t, eY)
+    c0 = f2_sub(dy, tx)
     return (X3, Y3, Z3), c0, theta, delta
 
 

@@ -37,24 +37,65 @@ def f2(c0: jnp.ndarray, c1: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([c0, c1], axis=-2)
 
 
+def _stack_bcast(els: list[jnp.ndarray]) -> jnp.ndarray:
+    shape = ()
+    for e in els:
+        shape = jnp.broadcast_shapes(shape, e.shape)
+    return jnp.stack([jnp.broadcast_to(e, shape) for e in els])
+
+
+def f2_mul_many(pairs: list[tuple[jnp.ndarray, jnp.ndarray]]
+                ) -> list[jnp.ndarray]:
+    """K independent Fp2 karatsuba products through ONE fp multiplier call
+    (3K stacked Fp products) and a constant number of carry scans — see
+    fp.mul_many for why this shape wins compile time and VPU width."""
+    k = len(pairs)
+    shape = ()   # one COMMON batch shape for both sides (rank-safe concat)
+    for a, b in pairs:
+        shape = jnp.broadcast_shapes(shape, a.shape[:-2], b.shape[:-2])
+    el = shape + (fp.NLIMBS,)
+
+    def stk(els):
+        return jnp.stack([jnp.broadcast_to(e, el) for e in els])
+
+    a0 = stk([a[..., 0, :] for a, _ in pairs])            # [K, ..., 32]
+    a1 = stk([a[..., 1, :] for a, _ in pairs])
+    b0 = stk([b[..., 0, :] for _, b in pairs])
+    b1 = stk([b[..., 1, :] for _, b in pairs])
+    sa = fp.add(a0, a1)
+    sb = fp.add(b0, b1)
+    t = fp.mul(jnp.concatenate([a0, a1, sa]),
+               jnp.concatenate([b0, b1, sb]))
+    t0, t1, t2 = t[:k], t[k : 2 * k], t[2 * k :]
+    c0 = fp.sub(t0, t1)
+    c1 = fp.sub(t2, fp.add(t0, t1))
+    return [f2(c0[i], c1[i]) for i in range(k)]
+
+
 def f2_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    b0, b1 = b[..., 0, :], b[..., 1, :]
-    t0 = fp.mul(a0, b0)
-    t1 = fp.mul(a1, b1)
-    t2 = fp.mul(fp.add(a0, a1), fp.add(b0, b1))
-    return f2(fp.sub(t0, t1), fp.sub(t2, fp.add(t0, t1)))
+    [out] = f2_mul_many([(a, b)])
+    return out
+
+
+def f2_sqr_many(els: list[jnp.ndarray]) -> list[jnp.ndarray]:
+    """K independent Fp2 squarings (2K stacked Fp products)."""
+    k = len(els)
+    a0 = _stack_bcast([a[..., 0, :] for a in els])
+    a1 = _stack_bcast([a[..., 1, :] for a in els])
+    t = fp.mul(jnp.concatenate([fp.add(a0, a1), a0]),
+               jnp.concatenate([fp.sub(a0, a1), a1]))
+    return [f2(t[i], fp.double(t[k + i])) for i in range(k)]
 
 
 def f2_sqr(a: jnp.ndarray) -> jnp.ndarray:
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    return f2(fp.mul(fp.add(a0, a1), fp.sub(a0, a1)),
-              fp.double(fp.mul(a0, a1)))
+    [out] = f2_sqr_many([a])
+    return out
 
 
 def f2_mul_fp(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
-    """Multiply both coefficients by an Fp scalar s [..., 32]."""
-    return f2(fp.mul(a[..., 0, :], s), fp.mul(a[..., 1, :], s))
+    """Multiply both coefficients by an Fp scalar s [..., 32] — one batched
+    fp product over the coefficient axis."""
+    return fp.mul(a, s[..., None, :])
 
 
 def f2_conj(a: jnp.ndarray) -> jnp.ndarray:
@@ -69,8 +110,10 @@ def f2_mul_by_xi(a: jnp.ndarray) -> jnp.ndarray:
 
 def f2_inv(a: jnp.ndarray) -> jnp.ndarray:
     a0, a1 = a[..., 0, :], a[..., 1, :]
-    norm_inv = fp.inv(fp.add(fp.sqr(a0), fp.sqr(a1)))
-    return f2(fp.mul(a0, norm_inv), fp.neg(fp.mul(a1, norm_inv)))
+    s0, s1 = fp.mul_many([(a0, a0), (a1, a1)])
+    norm_inv = fp.inv(fp.add(s0, s1))
+    t0, t1 = fp.mul_many([(a0, norm_inv), (a1, norm_inv)])
+    return f2(t0, fp.neg(t1))
 
 
 def f2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
@@ -88,6 +131,27 @@ def f2_select(cond, a, b):
 def f2_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.stack([fp.mul_small(a[..., 0, :], k),
                       fp.mul_small(a[..., 1, :], k)], axis=-2)
+
+
+def f2_pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e in Fp2 (Montgomery in/out) for a compile-time exponent — the
+    building block of the device square root (ops/codec.py)."""
+    from jax import lax
+
+    if e == 0:
+        return jnp.broadcast_to(jnp.asarray(F2_ONE_M), a.shape)
+    nbits = e.bit_length()
+    bits = jnp.asarray([(e >> i) & 1 for i in range(nbits)], jnp.int32)
+
+    def body(i, state):
+        result, base = state
+        r2, b2 = f2_mul_many([(result, base), (base, base)])
+        result = f2_select(bits[i] == 1, r2, result)
+        return result, b2
+
+    one = jnp.broadcast_to(jnp.asarray(F2_ONE_M), a.shape)
+    result, _ = lax.fori_loop(0, nbits, body, (one, a))
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -108,21 +172,53 @@ f6_neg = fp.neg
 f6_double = fp.double
 
 
+def f6_mul_many(pairs: list[tuple[jnp.ndarray, jnp.ndarray]]
+                ) -> list[jnp.ndarray]:
+    """K independent Fp6 products — 6K Fp2 karatsuba products through one
+    fp multiplier call, operand/result additions batched in constant scan
+    count (toom-style v0..v2 + three cross sums)."""
+    k = len(pairs)
+    cs = [(_f6c(a), _f6c(b)) for a, b in pairs]
+    # operand sums, one batched add: (a1+a2),(b1+b2),(a0+a1),(b0+b1),(a0+a2),(b0+b2)
+    left = _stack_bcast(
+        [x for (a, b) in cs for x in (a[1], b[1], a[0], b[0], a[0], b[0])])
+    right = _stack_bcast(
+        [x for (a, b) in cs for x in (a[2], b[2], a[1], b[1], a[2], b[2])])
+    sums = fp.add(left, right)                      # [6K, ..., 2, 32]
+    f2_pairs = []
+    for i, ((a0, a1, a2), (b0, b1, b2)) in enumerate(cs):
+        s = sums[6 * i : 6 * i + 6]
+        f2_pairs += [(a0, b0), (a1, b1), (a2, b2),
+                     (s[0], s[1]), (s[2], s[3]), (s[4], s[5])]
+    ts = f2_mul_many(f2_pairs)
+    # result combining, batched: t = cross − (v_x + v_y); then ξ / plain adds
+    vx = _stack_bcast([ts[6 * i + j] for i in range(k) for j in (1, 0, 0)])
+    vy = _stack_bcast([ts[6 * i + j] for i in range(k) for j in (2, 1, 2)])
+    cross = _stack_bcast([ts[6 * i + j] for i in range(k) for j in (3, 4, 5)])
+    t = fp.sub(cross, fp.add(vx, vy))               # [3K, ..., 2, 32]
+    # xi-multiplies: ξ·t12 (for c0) and ξ·v2 (for c1), one batched call
+    xi_in = _stack_bcast(
+        [t[3 * i] for i in range(k)] + [ts[6 * i + 2] for i in range(k)])
+    xi_out = f2_mul_by_xi(xi_in)                    # [2K, ..., 2, 32]
+    base = _stack_bcast(
+        [ts[6 * i] for i in range(k)]               # v0   (c0)
+        + [t[3 * i + 1] for i in range(k)]          # t01  (c1)
+        + [t[3 * i + 2] for i in range(k)])         # t02  (c2)
+    addend = _stack_bcast(
+        [xi_out[i] for i in range(k)]               # ξ·t12
+        + [xi_out[k + i] for i in range(k)]         # ξ·v2
+        + [ts[6 * i + 1] for i in range(k)])        # v1
+    c = fp.add(base, addend)                        # [3K, ..., 2, 32]
+    return [f6(c[i], c[k + i], c[2 * k + i]) for i in range(k)]
+
+
 def f6_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    a0, a1, a2 = _f6c(a)
-    b0, b1, b2 = _f6c(b)
-    v0 = f2_mul(a0, b0)
-    v1 = f2_mul(a1, b1)
-    v2 = f2_mul(a2, b2)
-    c0 = f2_add(v0, f2_mul_by_xi(
-        f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)), f2_add(v1, v2))))
-    c1 = f2_add(f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)),
-                       f2_add(v0, v1)),
-                f2_mul_by_xi(v2))
-    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)),
-                       f2_add(v0, v2)),
-                v1)
-    return f6(c0, c1, c2)
+    [out] = f6_mul_many([(a, b)])
+    return out
+
+
+def f6_sqr_many(els: list[jnp.ndarray]) -> list[jnp.ndarray]:
+    return f6_mul_many([(a, a) for a in els])
 
 
 def f6_sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -135,39 +231,57 @@ def f6_mul_by_v(a: jnp.ndarray) -> jnp.ndarray:
     return f6(f2_mul_by_xi(a2), a0, a1)
 
 
+def f6_mul_by_01_many(triples: list[tuple[jnp.ndarray, jnp.ndarray,
+                                          jnp.ndarray]]) -> list[jnp.ndarray]:
+    """K independent sparse (d0 + d1·v) products — 5K Fp2 products in one
+    batched call (pairing line-function helper)."""
+    k = len(triples)
+    f2_pairs = []
+    for a, d0, d1 in triples:
+        a0, a1, a2 = _f6c(a)
+        f2_pairs += [(a0, d0), (a1, d1), (f2_add(a1, a2), d1),
+                     (f2_add(a0, a1), f2_add(d0, d1)),
+                     (f2_add(a0, a2), d0)]
+    ts = f2_mul_many(f2_pairs)
+    out = []
+    for i in range(k):
+        v0, v1, x12, x01, x02 = ts[5 * i : 5 * i + 5]
+        c0 = f2_add(v0, f2_mul_by_xi(f2_sub(x12, v1)))
+        c1 = f2_sub(x01, f2_add(v0, v1))
+        c2 = f2_add(f2_sub(x02, v0), v1)
+        out.append(f6(c0, c1, c2))
+    return out
+
+
 def f6_mul_by_01(a: jnp.ndarray, d0: jnp.ndarray, d1: jnp.ndarray) -> jnp.ndarray:
-    """Multiply by sparse d0 + d1·v (pairing line-function helper)."""
-    a0, a1, a2 = _f6c(a)
-    v0 = f2_mul(a0, d0)
-    v1 = f2_mul(a1, d1)
-    c0 = f2_add(v0, f2_mul_by_xi(
-        f2_sub(f2_mul(f2_add(a1, a2), d1), v1)))
-    c1 = f2_sub(f2_mul(f2_add(a0, a1), f2_add(d0, d1)), f2_add(v0, v1))
-    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), d0), v0), v1)
-    return f6(c0, c1, c2)
+    [out] = f6_mul_by_01_many([(a, d0, d1)])
+    return out
 
 
 def f6_mul_by_1(a: jnp.ndarray, d1: jnp.ndarray) -> jnp.ndarray:
-    """Multiply by sparse d1·v."""
-    a0, a1, a2 = _f6c(a)
-    return f6(f2_mul_by_xi(f2_mul(a2, d1)), f2_mul(a0, d1), f2_mul(a1, d1))
+    """Multiply by sparse d1·v — one Fp2 product batched over the three
+    coefficients via the v-rotation."""
+    prod = f2_mul(a, d1[..., None, :, :])           # [..., 3, 2, 32]
+    a0d, a1d, a2d = _f6c(prod)
+    return f6(f2_mul_by_xi(a2d), a0d, a1d)
 
 
 def f6_mul_f2(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
-    """Scale every Fp2 coefficient by s ∈ Fp2."""
-    a0, a1, a2 = _f6c(a)
-    return f6(f2_mul(a0, s), f2_mul(a1, s), f2_mul(a2, s))
+    """Scale every Fp2 coefficient by s ∈ Fp2 (coefficient axis batched)."""
+    return f2_mul(a, s[..., None, :, :])
 
 
 def f6_inv(a: jnp.ndarray) -> jnp.ndarray:
     a0, a1, a2 = _f6c(a)
-    A = f2_sub(f2_sqr(a0), f2_mul_by_xi(f2_mul(a1, a2)))
-    B = f2_sub(f2_mul_by_xi(f2_sqr(a2)), f2_mul(a0, a1))
-    C = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
-    F = f2_add(f2_mul(a0, A),
-               f2_mul_by_xi(f2_add(f2_mul(a2, B), f2_mul(a1, C))))
-    Finv = f2_inv(F)
-    return f6(f2_mul(A, Finv), f2_mul(B, Finv), f2_mul(C, Finv))
+    s0, s1, s2, p12, p01, p02 = f2_mul_many(
+        [(a0, a0), (a1, a1), (a2, a2), (a1, a2), (a0, a1), (a0, a2)])
+    A = f2_sub(s0, f2_mul_by_xi(p12))
+    B = f2_sub(f2_mul_by_xi(s2), p01)
+    C = f2_sub(s1, p02)
+    fa, fb, fc = f2_mul_many([(a0, A), (a2, B), (a1, C)])
+    Finv = f2_inv(f2_add(fa, f2_mul_by_xi(f2_add(fb, fc))))
+    ra, rb, rc = f2_mul_many([(A, Finv), (B, Finv), (C, Finv)])
+    return f6(ra, rb, rc)
 
 
 def f6_select(cond, a, b):
@@ -190,20 +304,35 @@ f12_add = fp.add
 f12_sub = fp.sub
 
 
+def f12_mul_many(pairs: list[tuple[jnp.ndarray, jnp.ndarray]]
+                 ) -> list[jnp.ndarray]:
+    """K independent Fp12 karatsuba products — 3K Fp6 = 18K Fp2 = 54K Fp
+    products through ONE multiplier invocation."""
+    k = len(pairs)
+    f6_pairs = []
+    for a, b in pairs:
+        a0, a1 = _f12c(a)
+        b0, b1 = _f12c(b)
+        f6_pairs += [(a0, b0), (a1, b1), (f6_add(a0, a1), f6_add(b0, b1))]
+    ts = f6_mul_many(f6_pairs)
+    out = []
+    for i in range(k):
+        aa, bb, cross = ts[3 * i : 3 * i + 3]
+        c1 = f6_sub(cross, f6_add(aa, bb))
+        c0 = f6_add(aa, f6_mul_by_v(bb))
+        out.append(f12(c0, c1))
+    return out
+
+
 def f12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    a0, a1 = _f12c(a)
-    b0, b1 = _f12c(b)
-    aa = f6_mul(a0, b0)
-    bb = f6_mul(a1, b1)
-    c1 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)), f6_add(aa, bb))
-    c0 = f6_add(aa, f6_mul_by_v(bb))
-    return f12(c0, c1)
+    [out] = f12_mul_many([(a, b)])
+    return out
 
 
 def f12_sqr(a: jnp.ndarray) -> jnp.ndarray:
     a0, a1 = _f12c(a)
-    v0 = f6_mul(a0, a1)
-    t = f6_mul(f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1)))
+    v0, t = f6_mul_many([(a0, a1),
+                         (f6_add(a0, a1), f6_add(a0, f6_mul_by_v(a1)))])
     c0 = f6_sub(f6_sub(t, v0), f6_mul_by_v(v0))
     c1 = f6_double(v0)
     return f12(c0, c1)
@@ -217,19 +346,43 @@ def f12_conj(a: jnp.ndarray) -> jnp.ndarray:
 
 def f12_inv(a: jnp.ndarray) -> jnp.ndarray:
     a0, a1 = _f12c(a)
-    t = f6_inv(f6_sub(f6_sqr(a0), f6_mul_by_v(f6_sqr(a1))))
-    return f12(f6_mul(a0, t), f6_neg(f6_mul(a1, t)))
+    s0, s1 = f6_sqr_many([a0, a1])
+    t = f6_inv(f6_sub(s0, f6_mul_by_v(s1)))
+    m0, m1 = f6_mul_many([(a0, t), (a1, t)])
+    return f12(m0, f6_neg(m1))
 
 
 def f12_mul_by_014(a: jnp.ndarray, c0: jnp.ndarray, c1: jnp.ndarray,
                    c4: jnp.ndarray) -> jnp.ndarray:
-    """Multiply by the sparse line value (c0 + c1·v) + (c4·v)·w  — the shape
-    produced by the M-twist line evaluation (pairing.py)."""
+    """Multiply by the sparse line value (c0 + c1·v) + (c4·v)·w — the shape
+    produced by the M-twist line evaluation (pairing.py).  All 13 Fp2
+    products (two sparse-01 products + the coefficient-wise c4 product) go
+    through one batched multiplier call."""
     a0, a1 = _f12c(a)
-    aa = f6_mul_by_01(a0, c0, c1)
-    bb = f6_mul_by_1(a1, c4)
+    a00, a01, a02 = _f6c(a0)
+    s = f6_add(a0, a1)
+    s0, s1, s2 = _f6c(s)
     o = f2_add(c1, c4)
-    r1 = f6_sub(f6_mul_by_01(f6_add(a0, a1), c0, o), f6_add(aa, bb))
+    ts = f2_mul_many([
+        # f6_mul_by_01(a0; c0, c1) — 5 products
+        (a00, c0), (a01, c1), (f2_add(a01, a02), c1),
+        (f2_add(a00, a01), f2_add(c0, c1)), (f2_add(a00, a02), c0),
+        # f6_mul_by_01(a0+a1; c0, o) — 5 products
+        (s0, c0), (s1, o), (f2_add(s1, s2), o),
+        (f2_add(s0, s1), f2_add(c0, o)), (f2_add(s0, s2), c0),
+        # f6_mul_by_1(a1; c4) — 3 coefficient products
+        (a1[..., 0, :, :], c4), (a1[..., 1, :, :], c4), (a1[..., 2, :, :], c4),
+    ])
+
+    def combine01(v0, v1, x12, x01, x02):
+        return f6(f2_add(v0, f2_mul_by_xi(f2_sub(x12, v1))),
+                  f2_sub(x01, f2_add(v0, v1)),
+                  f2_add(f2_sub(x02, v0), v1))
+
+    aa = combine01(*ts[0:5])
+    t6 = combine01(*ts[5:10])
+    bb = f6(f2_mul_by_xi(ts[12]), ts[10], ts[11])
+    r1 = f6_sub(t6, f6_add(aa, bb))
     r0 = f6_add(f6_mul_by_v(bb), aa)
     return f12(r0, r1)
 

@@ -1,12 +1,13 @@
-"""charon_tpu.p2p — authenticated TCP mesh between cluster nodes.
+"""charon_tpu.p2p — authenticated-encrypted TCP mesh between cluster nodes.
 
 The reference's cluster transport is libp2p TCP + discv5 UDP + circuit
 relays (reference: p2p/, SURVEY.md §2.3).  This re-design keeps what makes
 that layer work — a full n² direct mesh (chosen over gossip for latency,
 reference docs/architecture.md:544-549), protocol-ID routing, the
 `send`/`register_handler` abstraction that lets every protocol be unit-
-tested in memory — on asyncio TCP with per-pair HMAC frame authentication
-derived from the cluster secret (see transport.py for the threat model).
+tested in memory — on asyncio TCP with per-node Ed25519 identities, a
+signed-ephemeral X25519 handshake and ChaCha20-Poly1305 frames (the
+noise-handshake equivalent; see transport.py for the threat model).
 
 Discovery is static peer addressing from the cluster config (the
 reference's discv5 exists to find NATed home stakers; a TPU-pod
@@ -14,6 +15,8 @@ deployment has stable addressing, so static + periodic reconnect is the
 idiomatic equivalent; relay support is a future round).
 """
 
-from .transport import Peer, TCPMesh, frame_key
+from .identity import NodeIdentity, enr_parse, verify as verify_sig
+from .transport import Peer, TCPMesh, new_test_identities
 
-__all__ = ["Peer", "TCPMesh", "frame_key"]
+__all__ = ["Peer", "TCPMesh", "NodeIdentity", "enr_parse", "verify_sig",
+           "new_test_identities"]

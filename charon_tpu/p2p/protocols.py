@@ -16,13 +16,41 @@ makes the whole workflow unit-testable (reference: docs/architecture.md:198-200)
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 
 from ..core import serialize
 from ..core.qbft import Msg
 from ..core.types import Duty, ParSignedDataSet
+from . import identity as ident
 
 PARSIGEX_PROTOCOL = "/charon_tpu/parsigex/1.0.0"
 CONSENSUS_PROTOCOL = "/charon_tpu/consensus/qbft/1.0.0"
+
+
+def sign_consensus_msg(msg: Msg, node_identity: ident.NodeIdentity) -> Msg:
+    """Attach the sender's identity signature over the message's signing
+    payload (reference: core/consensus/component.go:343-353 signs each
+    QBFT message with the node's ECDSA key)."""
+    payload = serialize.encode(msg.signing_payload())
+    return dataclasses.replace(msg, sig=node_identity.sign(payload))
+
+
+def verify_consensus_msg(msg: Msg, peer_pubkeys: dict[int, bytes],
+                         depth: int = 0) -> bool:
+    """Verify the message signature against its claimed source, and every
+    justification message recursively (PRE_PREPAREs justify with ROUND_CHANGEs
+    which justify with PREPAREs) — relayed justifications are exactly what a
+    byzantine insider could otherwise forge."""
+    if depth > 3:
+        return False
+    pub = peer_pubkeys.get(msg.source)
+    if pub is None or not msg.sig:
+        return False
+    payload = serialize.encode(msg.signing_payload())
+    if not ident.verify(pub, msg.sig, payload):
+        return False
+    return all(verify_consensus_msg(j, peer_pubkeys, depth + 1)
+               for j in msg.justification)
 
 
 class P2PParSigEx:
@@ -53,7 +81,13 @@ class P2PParSigEx:
 class P2PConsensusTransport:
     """Duty-scoped QBFT broadcast over the mesh, self-delivery included
     (QBFT requires the sender to receive its own messages).  Plugs into
-    core.consensus.QBFTConsensus in place of ConsensusMemNetwork."""
+    core.consensus.QBFTConsensus in place of ConsensusMemNetwork.
+
+    Every outgoing message is signed with the node's identity key; every
+    inbound message — including relayed justification messages — is
+    verified against the pinned peer pubkeys, so a byzantine insider cannot
+    forge another member's consensus votes
+    (reference: core/consensus/component.go:343-353)."""
 
     def __init__(self, mesh):
         self._mesh = mesh
@@ -64,15 +98,19 @@ class P2PConsensusTransport:
         self._node = node
 
     async def broadcast(self, duty: Duty, msg: Msg) -> None:
+        if msg.source == self._mesh.self_index:
+            msg = sign_consensus_msg(msg, self._mesh.identity)
         data = serialize.encode_consensus_msg(duty, msg)
         await self._mesh.broadcast(CONSENSUS_PROTOCOL, data)
-        if self._node is not None:  # self-delivery
+        if self._node is not None:  # self-delivery (of the signed copy)
             await self._node._deliver(duty, msg)
 
     async def _on_frame(self, sender: int, payload: bytes):
         duty, msg = serialize.decode_consensus_msg(payload)
         if msg.source != sender:
-            return None  # spoofed source: drop (ECDSA-verify analogue)
+            return None  # spoofed source: drop
+        if not verify_consensus_msg(msg, self._mesh.peer_pubkeys):
+            return None  # forged message or justification: drop
         if self._node is not None:
             await self._node._deliver(duty, msg)
         return None

@@ -1,5 +1,4 @@
-"""TCP mesh transport: length-prefixed, HMAC-authenticated frames with
-protocol-ID routing.
+"""TCP mesh transport: authenticated-encrypted frames with protocol routing.
 
 Reference analogues:
 - `send_async` / `send_receive` / `register_handler`
@@ -8,31 +7,49 @@ Reference analogues:
   stream convention) multiplexed over one persistent connection per peer,
 - per-peer failure hysteresis logging (sender.go:53-110 semantics,
   simplified to counters exposed for the tracker/metrics),
-- ping keepalive with RTT measurement (p2p/ping.go:37-234).
+- ping keepalive with RTT measurement (p2p/ping.go:37-234),
+- channel security ≙ libp2p noise + conn-gater (p2p/p2p.go:42-99,
+  p2p/gater.go): a signed-ephemeral handshake pins each connection to a
+  cluster member's identity key, then all frames are AEAD-encrypted.
 
-Authentication: every frame carries an HMAC-SHA256 over the payload with a
-pairwise key derived from (cluster_secret, sorted peer indices).  Within
-the fixed-membership DV cluster (membership is cryptographically pinned by
-the cluster lock) this provides peer authenticity and integrity; it
-replaces libp2p's noise handshake with something with zero external deps.
-Frames also carry the sender index, verified against the pairwise key.
+Handshake (per TCP connection; identities are Ed25519 keys pinned in the
+cluster definition, ephemerals are X25519):
 
-Wire format (all big-endian):
-    u32 frame_len | u16 proto_len | proto | u8 sender | u64 msg_id |
-    u8 is_reply | payload | 32B hmac
+    dialer   → index(1) ‖ eph_i(32)
+    listener → index(1) ‖ eph_r(32) ‖ sig_r("resp" ‖ cluster ‖ eph_i ‖ eph_r)
+    dialer   → sig_i("init" ‖ cluster ‖ eph_i ‖ eph_r)
+
+Both signatures cover BOTH fresh ephemerals, so neither a MITM insider nor
+a transcript replay can impersonate a member.  Session keys are HKDF-style
+derivations of the X25519 shared secret (one key per direction); frames are
+ChaCha20-Poly1305 with strictly-increasing counter nonces (replay-proof).
+This fixes the round-1 finding that pairwise HMAC keys derived from a
+shared cluster secret were insider-forgeable, and gives DKG share
+transfers confidentiality on the wire.
+
+Wire format after the handshake (big-endian):
+    u32 frame_len | u64 counter | ciphertext
+ciphertext = AEAD(body), body = u16 proto_len | proto | u8 sender |
+u64 msg_id | u8 is_reply | payload.
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
-import hmac as hmac_mod
 import json
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
 
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from . import identity as ident
+
 MAX_FRAME = 32 * 1024 * 1024
+HS_TIMEOUT = 5.0
 
 
 @dataclass(frozen=True)
@@ -55,31 +72,73 @@ class Peer:
                 f"{animals[h.digest()[1] % len(animals)]}-{self.index}")
 
 
-def frame_key(cluster_secret: bytes, a: int, b: int) -> bytes:
-    """Pairwise frame-auth key for peers a and b."""
-    lo, hi = sorted((a, b))
-    return hashlib.sha256(b"p2p-frame" + cluster_secret
-                          + bytes([lo, hi])).digest()
+class _Channel:
+    """One authenticated-encrypted connection to a specific peer."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, peer_index: int,
+                 send_key: bytes, recv_key: bytes):
+        self.reader = reader
+        self.writer = writer
+        self.peer_index = peer_index
+        self._send = ChaCha20Poly1305(send_key)
+        self._recv = ChaCha20Poly1305(recv_key)
+        self._send_ctr = 0
+        self._recv_ctr = -1
+
+    def seal(self, body: bytes) -> bytes:
+        self._send_ctr += 1
+        nonce = b"\x00\x00\x00\x00" + struct.pack(">Q", self._send_ctr)
+        ct = self._send.encrypt(nonce, body, None)
+        frame = struct.pack(">Q", self._send_ctr) + ct
+        return struct.pack(">I", len(frame)) + frame
+
+    def open(self, frame: bytes) -> bytes | None:
+        """Decrypt one frame; None on forgery or replay."""
+        if len(frame) < 8 + 16:
+            return None
+        (ctr,) = struct.unpack(">Q", frame[:8])
+        if ctr <= self._recv_ctr:
+            return None  # replayed or reordered: drop
+        nonce = b"\x00\x00\x00\x00" + frame[:8]
+        try:
+            body = self._recv.decrypt(nonce, frame[8:], None)
+        except Exception:
+            return None
+        self._recv_ctr = ctr
+        return body
+
+
+def _derive_keys(shared: bytes, cluster_hash: bytes, eph_i: bytes,
+                 eph_r: bytes) -> tuple[bytes, bytes]:
+    """(initiator→responder key, responder→initiator key)."""
+    base = shared + cluster_hash + eph_i + eph_r
+    return (hashlib.sha256(b"ct-i2r" + base).digest(),
+            hashlib.sha256(b"ct-r2i" + base).digest())
 
 
 class TCPMesh:
     """One node's endpoint in the full mesh."""
 
     def __init__(self, self_index: int, peers: list[Peer],
-                 cluster_secret: bytes):
+                 node_identity: ident.NodeIdentity,
+                 peer_pubkeys: dict[int, bytes],
+                 cluster_hash: bytes = b""):
         self.self_index = self_index
         self.peers = {p.index: p for p in peers if p.index != self_index}
         self.self_peer = next(p for p in peers if p.index == self_index)
-        self._secret = cluster_secret
+        self.identity = node_identity
+        self.peer_pubkeys = dict(peer_pubkeys)
+        self.cluster_hash = cluster_hash
         self._handlers: dict[str, Callable] = {}
-        self._conns: dict[int, tuple[asyncio.StreamReader,
-                                     asyncio.StreamWriter]] = {}
+        self._channels: dict[int, _Channel] = {}
         self._conn_locks: dict[int, asyncio.Lock] = {}
         self._pending: dict[int, asyncio.Future] = {}
         self._msg_id = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._tasks: list[asyncio.Task] = []
-        self._inbound_writers: list[asyncio.StreamWriter] = []
+        self._inbound: list[_Channel] = []
+        self._raw_writers: list[asyncio.StreamWriter] = []
         # failure hysteresis counters (reference: p2p/sender.go:53-110)
         self.send_failures: dict[int, int] = {}
         self.rtts: dict[int, float] = {}
@@ -93,12 +152,15 @@ class TCPMesh:
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
-        for _, w in self._conns.values():
+        for ch in self._channels.values():
+            ch.writer.close()
+        self._channels.clear()
+        for ch in self._inbound:
+            ch.writer.close()
+        self._inbound.clear()
+        for w in self._raw_writers:
             w.close()
-        self._conns.clear()
-        for w in self._inbound_writers:
-            w.close()
-        self._inbound_writers.clear()
+        self._raw_writers.clear()
         if self._server is not None:
             self._server.close()
             # wait_closed() blocks until every inbound connection is done
@@ -112,7 +174,9 @@ class TCPMesh:
 
     def register_handler(self, protocol: str,
                          fn: Callable[[int, bytes], Awaitable[bytes | None]]):
-        """fn(sender_index, payload) -> optional reply payload."""
+        """fn(sender_index, payload) -> optional reply payload.  The sender
+        index is the handshake-authenticated channel identity, not a frame
+        field a peer could spoof."""
         self._handlers[protocol] = fn
 
     # -- send paths (reference: p2p/sender.go:112-251) ---------------------
@@ -160,72 +224,133 @@ class TCPMesh:
             return b"pong"
         self.register_handler("/charon_tpu/ping/1.0.0", _pong)
 
+    # -- handshake ----------------------------------------------------------
+
+    async def _handshake_initiator(self, reader, writer,
+                                   peer_index: int) -> _Channel:
+        eph = X25519PrivateKey.generate()
+        eph_i = eph.public_key().public_bytes_raw()
+        writer.write(bytes([self.self_index]) + eph_i)
+        await writer.drain()
+        resp = await asyncio.wait_for(reader.readexactly(1 + 32 + 64),
+                                      HS_TIMEOUT)
+        r_index, eph_r, sig_r = resp[0], resp[1:33], resp[33:]
+        if r_index != peer_index:
+            raise ConnectionError("handshake: wrong responder index")
+        pub = self.peer_pubkeys.get(r_index)
+        ctx = self.cluster_hash + eph_i + eph_r
+        if pub is None or not ident.verify(pub, sig_r, b"resp" + ctx):
+            raise ConnectionError("handshake: bad responder signature")
+        writer.write(self.identity.sign(b"init" + ctx))
+        await writer.drain()
+        shared = eph.exchange(X25519PublicKey.from_public_bytes(eph_r))
+        k_i2r, k_r2i = _derive_keys(shared, self.cluster_hash, eph_i, eph_r)
+        return _Channel(reader, writer, peer_index, k_i2r, k_r2i)
+
+    async def _handshake_responder(self, reader, writer) -> _Channel:
+        hello = await asyncio.wait_for(reader.readexactly(1 + 32), HS_TIMEOUT)
+        i_index, eph_i = hello[0], hello[1:]
+        pub = self.peer_pubkeys.get(i_index)
+        if pub is None or i_index == self.self_index:
+            raise ConnectionError("handshake: unknown initiator")
+        eph = X25519PrivateKey.generate()
+        eph_r = eph.public_key().public_bytes_raw()
+        ctx = self.cluster_hash + eph_i + eph_r
+        writer.write(bytes([self.self_index]) + eph_r
+                     + self.identity.sign(b"resp" + ctx))
+        await writer.drain()
+        sig_i = await asyncio.wait_for(reader.readexactly(64), HS_TIMEOUT)
+        if not ident.verify(pub, sig_i, b"init" + ctx):
+            raise ConnectionError("handshake: bad initiator signature")
+        shared = eph.exchange(X25519PublicKey.from_public_bytes(eph_i))
+        k_i2r, k_r2i = _derive_keys(shared, self.cluster_hash, eph_i, eph_r)
+        return _Channel(reader, writer, i_index, k_r2i, k_i2r)
+
     # -- internals ----------------------------------------------------------
 
     def _next_id(self) -> int:
         self._msg_id += 1
         return (self.self_index << 48) | self._msg_id
 
-    async def _connect(self, peer_index: int):
+    async def _connect(self, peer_index: int) -> _Channel:
         lock = self._conn_locks.setdefault(peer_index, asyncio.Lock())
         async with lock:
-            conn = self._conns.get(peer_index)
-            if conn is not None and not conn[1].is_closing():
-                return conn
+            ch = self._channels.get(peer_index)
+            if ch is not None and not ch.writer.is_closing():
+                return ch
             peer = self.peers[peer_index]
             reader, writer = await asyncio.open_connection(peer.host,
                                                            peer.port)
-            self._conns[peer_index] = (reader, writer)
-            # identify ourselves with one hello frame, then read replies
+            try:
+                ch = await self._handshake_initiator(reader, writer,
+                                                     peer_index)
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError) as e:
+                writer.close()
+                raise ConnectionError(f"handshake with {peer_index}: {e}")
+            self._channels[peer_index] = ch
             self._tasks.append(asyncio.get_event_loop().create_task(
-                self._read_loop(reader, peer_index)))
-            return reader, writer
+                self._read_loop(ch)))
+            return ch
 
-    def _encode(self, peer_index: int, protocol: str, payload: bytes,
-                msg_id: int, is_reply: bool) -> bytes:
+    def _encode_body(self, protocol: str, payload: bytes, msg_id: int,
+                     is_reply: bool) -> bytes:
         proto_b = protocol.encode()
-        body = (struct.pack(">H", len(proto_b)) + proto_b
+        return (struct.pack(">H", len(proto_b)) + proto_b
                 + bytes([self.self_index]) + struct.pack(">Q", msg_id)
                 + bytes([1 if is_reply else 0]) + payload)
-        mac = hmac_mod.new(frame_key(self._secret, self.self_index,
-                                     peer_index), body,
-                           hashlib.sha256).digest()
-        frame = body + mac
-        return struct.pack(">I", len(frame)) + frame
 
     async def _send_frame(self, peer_index: int, protocol: str,
                           payload: bytes, msg_id: int, is_reply: bool):
-        _, writer = await self._connect(peer_index)
-        writer.write(self._encode(peer_index, protocol, payload, msg_id,
-                                  is_reply))
-        await writer.drain()
+        ch = await self._connect(peer_index)
+        ch.writer.write(ch.seal(self._encode_body(protocol, payload, msg_id,
+                                                  is_reply)))
+        await ch.writer.drain()
 
     async def _on_inbound(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
-        self._inbound_writers.append(writer)
         # Serve this connection inline: start_server tracks the handler
         # coroutine, so returning early would make wait_closed() hang on
-        # the still-running read task.
-        await self._read_loop(reader, None, writer)
+        # the still-running read task.  Track the raw writer immediately so
+        # stop() can sever connections stuck mid-handshake.
+        self._raw_writers.append(writer)
+        try:
+            ch = await self._handshake_responder(reader, writer)
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, OSError):
+            writer.close()
+            return
+        finally:
+            if writer in self._raw_writers:
+                self._raw_writers.remove(writer)
+        self._inbound.append(ch)
+        await self._read_loop(ch)
 
-    async def _read_loop(self, reader: asyncio.StreamReader,
-                         expected_sender: int | None,
-                         writer: asyncio.StreamWriter | None = None) -> None:
+    async def _read_loop(self, ch: _Channel) -> None:
         try:
             while True:
-                hdr = await reader.readexactly(4)
+                hdr = await ch.reader.readexactly(4)
                 (length,) = struct.unpack(">I", hdr)
                 if length > MAX_FRAME:
-                    return
-                frame = await reader.readexactly(length)
-                await self._on_frame(frame, expected_sender, writer)
+                    break
+                frame = await ch.reader.readexactly(length)
+                body = ch.open(frame)
+                if body is None:
+                    break  # forged/replayed frame: kill the connection
+                await self._on_body(ch, body)
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
-            return
+            pass
+        finally:
+            # actually sever the connection and forget the channel so the
+            # next send reconnects instead of reusing a dead session
+            ch.writer.close()
+            if self._channels.get(ch.peer_index) is ch:
+                del self._channels[ch.peer_index]
+            if ch in self._inbound:
+                self._inbound.remove(ch)
 
-    async def _on_frame(self, frame: bytes, expected_sender: int | None,
-                        writer: asyncio.StreamWriter | None) -> None:
-        body, mac = frame[:-32], frame[-32:]
+    async def _on_body(self, ch: _Channel, body: bytes) -> None:
         (proto_len,) = struct.unpack(">H", body[:2])
         off = 2
         protocol = body[off : off + proto_len].decode()
@@ -238,16 +363,9 @@ class TCPMesh:
         off += 1
         payload = body[off:]
 
-        # authenticate: conn-gating equivalent (reference: p2p/gater.go) —
-        # frames from non-members or with bad MACs are dropped.
-        if expected_sender is not None and sender != expected_sender:
-            return
-        if sender == self.self_index or (
-                sender not in self.peers and sender != self.self_index):
-            return
-        want = hmac_mod.new(frame_key(self._secret, sender, self.self_index),
-                            body, hashlib.sha256).digest()
-        if not hmac_mod.compare_digest(want, mac):
+        # the channel identity is authoritative; a frame claiming another
+        # sender index is a protocol violation
+        if sender != ch.peer_index:
             return
 
         if is_reply:
@@ -261,15 +379,30 @@ class TCPMesh:
             return
         reply = await handler(sender, payload)
         if reply is not None:
-            # reply on the same connection if inbound, else via our conn
-            data = self._encode(sender, protocol, reply, msg_id,
-                                is_reply=True)
-            if writer is not None and not writer.is_closing():
-                writer.write(data)
-                await writer.drain()
-            else:
-                await self._send_frame(sender, protocol, reply, msg_id,
-                                       is_reply=True)
+            ch.writer.write(ch.seal(self._encode_body(protocol, reply,
+                                                      msg_id, is_reply=True)))
+            await ch.writer.drain()
+
+
+def mesh_params_from_definition(definition) -> tuple[list[Peer],
+                                                     dict[int, bytes]]:
+    """Build the mesh peer list + pinned identity pubkeys from a cluster
+    definition whose operator ENRs are `ed25519:<hex>@host:port` records
+    (reference: app/app.go:162-178 loads peers from the lock ENRs)."""
+    peers, pubs = [], {}
+    for i, enr in definition.peers():
+        pub, host, port = ident.enr_parse(enr)
+        peers.append(Peer(i, host, port))
+        pubs[i] = pub
+    return peers, pubs
+
+
+def new_test_identities(n: int, seed: bytes = b"test-cluster") -> tuple[
+        list[ident.NodeIdentity], dict[int, bytes]]:
+    """Deterministic per-node identities for tests/fixtures: n keypairs +
+    the pinned pubkey map every node shares."""
+    ids = [ident.NodeIdentity.generate(seed + bytes([i])) for i in range(n)]
+    return ids, {i: nid.pubkey for i, nid in enumerate(ids)}
 
 
 # ---------------------------------------------------------------------------

@@ -243,6 +243,11 @@ def batch_verify(entries: list[tuple[PubKey, bytes, Signature]]) -> list[bool]:
     if _scheme == "insecure-test":
         return [_InsecureScheme.verify(pk, msg, sig)
                 for pk, msg, sig in entries]
+    be = _backend()
+    if hasattr(be, "batch_verify_bytes"):
+        # bytes-native device path: decompression happens on-device, no
+        # per-entry Python parsing (see ops/codec.py)
+        return be.batch_verify_bytes(entries)
     parsed = []
     oks = [True] * len(entries)
     for k, (pk_b, msg, sig_b) in enumerate(entries):
@@ -264,6 +269,9 @@ def threshold_combine(
     the batched MSM the TPU kernels own."""
     if _scheme == "insecure-test":
         return [_InsecureScheme.combine(sigs) for sigs in batch]
+    be = _backend()
+    if hasattr(be, "threshold_combine_bytes"):
+        return be.threshold_combine_bytes(batch)
     parsed = [
         {i: curve.g2_from_bytes(s) for i, s in sigs.items()} for sigs in batch
     ]

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import shamir
+from ..ops import codec
 from ..ops import curve as jcurve
 from ..ops import pairing as jpair
 from ..ops.curve import F2_OPS
@@ -31,11 +32,29 @@ from ..tbls.ref import curve as refcurve
 from ..tbls.ref.hash_to_curve import hash_to_g2
 
 _NEG_G1 = jcurve.g1_pack([refcurve.neg(refcurve.G1_GEN)])[0]
+_G2_INF_BYTES = np.zeros(96, np.uint8)
+_G2_INF_BYTES[0] = 0xC0
 
 
 def _pad_pow2(n: int, floor: int = 1) -> int:
     m = max(n, floor)
     return 1 << (m - 1).bit_length()
+
+
+# Lagrange-coefficient bit planes cached per share-index set: within a slot
+# every validator aggregates the same t share indices, so the host computes
+# the modular inverses once per distinct set (reference recomputes per call,
+# tbls/tss.go:142-149).
+_LAG_BITS: dict[tuple[int, ...], np.ndarray] = {}
+
+
+def _lagrange_bits(idxs: tuple[int, ...]) -> np.ndarray:
+    out = _LAG_BITS.get(idxs)
+    if out is None:
+        lam = shamir.lagrange_coeffs_at_zero(list(idxs))
+        out = jcurve.scalars_to_bits([lam[i] for i in idxs])
+        _LAG_BITS[idxs] = out
+    return out
 
 
 @jax.jit
@@ -48,6 +67,43 @@ def _verify_kernel(ps, qs):
 def _combine_kernel(pts, bits):
     """pts [V, T, 3, 2, 32] G2 Jacobian, bits [V, T, 256] → [V, 3, 2, 32]."""
     return jcurve.msm(F2_OPS, pts, bits, axis=1)
+
+
+@jax.jit
+def _combine_bytes_kernel(xc0, xc1, sign, inf, bits):
+    """Fused bytes-path combine: decompress [V, T] G2 x-coordinates (batched
+    Fp2 sqrt), Lagrange-MSM along T, normalise back to std-form affine limbs.
+    One launch per padded (V, T) tier."""
+    pts, ok = codec.g2_decompress(xc0, xc1, sign, inf)
+    combined = jcurve.msm(F2_OPS, pts, bits, axis=1)
+    oxc0, oxc1, oyc0, oyc1, oinf = codec.g2_normalize(combined)
+    return oxc0, oxc1, oyc0, oyc1, oinf, ok
+
+
+@jax.jit
+def _verify_bytes_kernel(pk_x, pk_sign, pk_inf, sg_xc0, sg_xc1, sg_sign,
+                         sg_inf, hm_pts):
+    """Fused bytes-path verify: decompress pubkeys (G1) + signatures (G2),
+    then one pairing-product check e(−g1, sig)·e(pk, H(m)) == 1 per row."""
+    pks, ok1 = codec.g1_decompress(pk_x, pk_sign, pk_inf)
+    sigs, ok2 = codec.g2_decompress(sg_xc0, sg_xc1, sg_sign, sg_inf)
+    neg_g1 = jnp.broadcast_to(jnp.asarray(_NEG_G1), pks.shape)
+    ps = jnp.stack([neg_g1, pks], axis=1)       # [V, 2, 3, 32]
+    qs = jnp.stack([sigs, hm_pts], axis=1)      # [V, 2, 3, 2, 32]
+    ok = jpair.pairing_product_is_one(ps, qs, pair_axis=1)
+    # reject the identity pubkey / identity signature (eth2 POP scheme
+    # rejects infinity keys; also keeps padding rows from reading as valid
+    # real entries — padding validity is handled host-side by slicing)
+    nontrivial = ~codec_is_inf_g1(pks) & ~codec_is_inf_g2(sigs)
+    return ok & ok1 & ok2 & nontrivial
+
+
+def codec_is_inf_g1(pts):
+    return jcurve.is_inf(jcurve.FP_OPS, pts)
+
+
+def codec_is_inf_g2(pts):
+    return jcurve.is_inf(F2_OPS, pts)
 
 
 class TPUBackend:
@@ -106,3 +162,81 @@ class TPUBackend:
                 [lam[i] for i in idxs])
         out = _combine_kernel(jnp.asarray(pts), jnp.asarray(bits))
         return jcurve.g2_unpack(out)[: len(batch)]
+
+    # -- bytes-native paths (no Python loop over validators) ----------------
+
+    def threshold_combine_bytes(self, batch) -> list[bytes]:
+        """batch: list of {share_idx: 96-byte sig}; returns 96-byte group
+        signatures.  The whole batch crosses to the device as flat byte
+        arrays: host work is one vectorised bit-shuffle; decompression
+        (batched Fp2 sqrt), Lagrange MSM and normalisation are one fused
+        device launch (reference per-validator CPU path: tbls/tss.go:142-149)."""
+        if not batch:
+            return []
+        v = _pad_pow2(len(batch))
+        t = _pad_pow2(max(len(sigs) for sigs in batch))
+        raw = np.broadcast_to(_G2_INF_BYTES, (v, t, 96)).copy()
+        bits = np.zeros((v, t, jcurve.SCALAR_BITS), np.int32)
+        for row, sigs in enumerate(batch):
+            idxs = tuple(sigs)
+            if any(len(sigs[i]) != 96 for i in idxs):
+                raise ValueError("G2 compressed signature must be 96 bytes")
+            sig_bytes = b"".join(sigs[i] for i in idxs)
+            raw[row, : len(idxs)] = np.frombuffer(
+                sig_bytes, np.uint8).reshape(len(idxs), 96)
+            bits[row, : len(idxs)] = _lagrange_bits(idxs)
+        xc0, xc1, sign, inf, bad = codec.g2_bytes_split(raw.reshape(-1, 96))
+        if bad[: len(batch) * t].any():
+            raise ValueError("malformed compressed G2 signature in batch")
+        shape = (v, t, jcurve.fp.NLIMBS)
+        oxc0, oxc1, oyc0, oyc1, oinf, ok = _combine_bytes_kernel(
+            jnp.asarray(xc0.reshape(shape)), jnp.asarray(xc1.reshape(shape)),
+            jnp.asarray(sign.reshape(v, t)), jnp.asarray(inf.reshape(v, t)),
+            jnp.asarray(bits))
+        if not np.asarray(ok)[: len(batch)].all():
+            raise ValueError("signature bytes not on the G2 curve")
+        out = codec.g2_compress_np(np.asarray(oxc0), np.asarray(oxc1),
+                                   np.asarray(oyc0), np.asarray(oyc1),
+                                   np.asarray(oinf))
+        return [out[k].tobytes() for k in range(len(batch))]
+
+    _HM_CACHE: dict[bytes, np.ndarray] = {}
+
+    def _hash_point(self, msg: bytes) -> np.ndarray:
+        hm = self._HM_CACHE.get(msg)
+        if hm is None:
+            hm = jcurve.g2_pack([hash_to_g2(msg)])[0]
+            if len(self._HM_CACHE) > 4096:
+                self._HM_CACHE.clear()
+            self._HM_CACHE[msg] = hm
+        return hm
+
+    def batch_verify_bytes(self, entries) -> list[bool]:
+        """entries: [(48-byte pk, msg bytes, 96-byte sig)] → [bool].
+        Message hashing is host-side and cached per distinct message (a slot
+        has few distinct signing roots across many validators); pubkey and
+        signature decompression + the pairing product are one device launch."""
+        n = len(entries)
+        if n == 0:
+            return []
+        v = _pad_pow2(n)
+        pk_raw = np.zeros((v, 48), np.uint8)
+        pk_raw[:, 0] = 0xC0
+        sg_raw = np.broadcast_to(_G2_INF_BYTES, (v, 96)).copy()
+        hms = np.zeros((v, 3, 2, jcurve.fp.NLIMBS), np.int32)
+        length_ok = np.ones(v, bool)
+        for k, (pk, msg, sig) in enumerate(entries):
+            if len(pk) != 48 or len(sig) != 96:
+                length_ok[k] = False  # malformed entry: invalid, not fatal
+                continue
+            pk_raw[k] = np.frombuffer(pk, np.uint8)
+            sg_raw[k] = np.frombuffer(sig, np.uint8)
+            hms[k] = self._hash_point(msg)
+        pk_x, pk_sign, pk_inf, pk_bad = codec.g1_bytes_split(pk_raw)
+        sg_xc0, sg_xc1, sg_sign, sg_inf, sg_bad = codec.g2_bytes_split(sg_raw)
+        ok = _verify_bytes_kernel(
+            jnp.asarray(pk_x), jnp.asarray(pk_sign), jnp.asarray(pk_inf),
+            jnp.asarray(sg_xc0), jnp.asarray(sg_xc1), jnp.asarray(sg_sign),
+            jnp.asarray(sg_inf), jnp.asarray(hms))
+        ok = np.asarray(ok) & ~pk_bad & ~sg_bad & length_ok
+        return [bool(b) for b in ok[:n]]

@@ -142,8 +142,10 @@ def test_peerinfo_gossip_and_lock_mismatch():
     async def main():
         ports = free_ports(2)
         peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(2)]
-        m0 = TCPMesh(0, peers, b"s")
-        m1 = TCPMesh(1, peers, b"s")
+        from charon_tpu.p2p.transport import new_test_identities
+        ids, pubs = new_test_identities(2)
+        m0 = TCPMesh(0, peers, ids[0], pubs)
+        m1 = TCPMesh(1, peers, ids[1], pubs)
         await m0.start()
         await m1.start()
         try:

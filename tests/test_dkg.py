@@ -14,7 +14,8 @@ from charon_tpu.dkg.ceremony import run_dkg
 from charon_tpu.eth2util import keystore
 from charon_tpu.tbls import api as tbls
 from tests.test_p2p import free_ports
-from charon_tpu.p2p.transport import Peer, TCPMesh
+from charon_tpu.p2p.transport import (TCPMesh, mesh_params_from_definition,
+                                      new_test_identities)
 
 
 @pytest.fixture(autouse=True)
@@ -59,19 +60,22 @@ def test_pedersen_rejects_bad_share():
 def _run_ceremony(tmp_path, algorithm: str):
     n, t, m = 3, 2, 2
     ports = free_ports(n)
-    peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(n)]
+    # each operator's identity key is pinned in its definition ENR
+    ids, _ = new_test_identities(n, seed=b"dkg-ceremony")
     definition = Definition(
         name="test-cluster",
         operators=tuple(Operator(address=f"0x{i:040x}",
-                                 enr=f"127.0.0.1:{ports[i]}")
+                                 enr=ids[i].enr("127.0.0.1", ports[i]))
                         for i in range(n)),
         threshold=t, num_validators=m, dkg_algorithm=algorithm)
 
     async def main():
         from charon_tpu.cluster.definition import definition_hash
 
-        secret = definition_hash(definition)  # frame auth from def hash
-        meshes = [TCPMesh(i, peers, secret) for i in range(n)]
+        peers, pubs = mesh_params_from_definition(definition)
+        meshes = [TCPMesh(i, peers, ids[i], pubs,
+                          cluster_hash=definition_hash(definition))
+                  for i in range(n)]
         for mesh in meshes:
             await mesh.start()
         try:
@@ -129,3 +133,58 @@ def test_full_ceremony_over_tcp(tmp_path, algorithm):
             bytes.fromhex(d["withdrawal_credentials"]),
             definition.fork_version)
         assert tbls.verify(v.public_key, root, bytes.fromhex(d["signature"]))
+
+
+def test_equivocating_dealer_detected(tmp_path):
+    """A dealer sending different round-1 commitments to different peers is
+    named and the ceremony aborts (commitment echo round)."""
+    n, t, m = 3, 2, 1
+    ports = free_ports(n)
+    ids, _ = new_test_identities(n, seed=b"dkg-equivocate")
+    definition = Definition(
+        name="evil-cluster",
+        operators=tuple(Operator(address=f"0x{i:040x}",
+                                 enr=ids[i].enr("127.0.0.1", ports[i]))
+                        for i in range(n)),
+        threshold=t, num_validators=m, dkg_algorithm="pedersen")
+
+    async def main():
+        from charon_tpu.cluster.definition import definition_hash
+        from charon_tpu.dkg.ceremony import ROUND1_PROTOCOL
+        from charon_tpu.p2p.transport import encode_json, decode_json
+
+        peers, pubs = mesh_params_from_definition(definition)
+        meshes = [TCPMesh(i, peers, ids[i], pubs,
+                          cluster_hash=definition_hash(definition))
+                  for i in range(n)]
+        for mesh in meshes:
+            await mesh.start()
+
+        # node 0 equivocates: corrupt the commitments it sends to peer 2
+        orig_send = meshes[0].send_async
+
+        async def evil_send(peer, protocol, payload):
+            if protocol == ROUND1_PROTOCOL and peer == 2:
+                obj = decode_json(payload)
+                first = bytes.fromhex(obj["commitments"][0][0])
+                obj["commitments"][0][0] = (
+                    first[:-1] + bytes([first[-1] ^ 1])).hex()
+                payload = encode_json(obj)
+            await orig_send(peer, protocol, payload)
+
+        meshes[0].send_async = evil_send
+        try:
+            results = await asyncio.gather(*(
+                run_dkg(definition, meshes[i], i,
+                        str(tmp_path / f"node{i}"))
+                for i in range(n)), return_exceptions=True)
+            honest_errors = [r for r in results[1:]
+                             if isinstance(r, Exception)]
+            assert honest_errors, "honest nodes did not abort"
+            assert any("dealer 0" in str(e) or "equivocated" in str(e)
+                       or "participant" in str(e) for e in honest_errors)
+        finally:
+            for mesh in meshes:
+                await mesh.stop()
+
+    asyncio.run(main())

@@ -1,0 +1,169 @@
+"""Differential tests: device point codec vs the CPU oracle serialiser.
+
+Oracle: charon_tpu.tbls.ref.curve.{g1,g2}_{to,from}_bytes (ZCash format,
+reference: tbls/tblsconv/tblsconv.go:29-173).
+"""
+
+import numpy as np
+import pytest
+
+from charon_tpu.ops import codec, curve as jcurve, fp
+from charon_tpu.ops.curve import FP_OPS, F2_OPS
+from charon_tpu.tbls.ref import curve as refcurve
+from charon_tpu.tbls.ref.fields import FQ, FQ2, P
+
+
+def _rand_g1(rng, n):
+    return [refcurve.multiply(refcurve.G1_GEN, int(rng.integers(1, 1 << 62)))
+            for _ in range(n)]
+
+
+def _rand_g2(rng, n):
+    return [refcurve.multiply(refcurve.G2_GEN, int(rng.integers(1, 1 << 62)))
+            for _ in range(n)]
+
+
+def test_bytes_limbs_roundtrip():
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (5, 48), dtype=np.uint8)
+    limbs = codec.bytes48_to_limbs(raw)
+    # against the scalar oracle
+    for row, lim in zip(raw, limbs):
+        assert fp.from_limbs(lim) == int.from_bytes(row.tobytes(), "big")
+    back = codec.limbs_to_bytes48(limbs)
+    assert (back == raw).all()
+
+
+def test_limb_compares_vectorised():
+    vals = [0, 1, (P - 1) // 2, (P - 1) // 2 + 1, P - 1, P, P + 5]
+    limbs = np.stack([fp.to_limbs(v) for v in vals])
+    assert codec.limbs_lt_p(limbs).tolist() == [v < P for v in vals]
+    assert codec.limbs_sgn(limbs).tolist() == [v > (P - 1) // 2 for v in vals]
+
+
+def test_g2_decompress_matches_oracle():
+    rng = np.random.default_rng(1)
+    pts = _rand_g2(rng, 4) + [None]
+    raw = np.stack([np.frombuffer(refcurve.g2_to_bytes(p), np.uint8)
+                    for p in pts])
+    xc0, xc1, sign, inf, bad = codec.g2_bytes_split(raw)
+    assert not bad.any()
+    assert inf.tolist() == [False] * 4 + [True]
+    import jax.numpy as jnp
+    pt_dev, ok = codec.g2_decompress(jnp.asarray(xc0), jnp.asarray(xc1),
+                                     jnp.asarray(sign), jnp.asarray(inf))
+    assert np.asarray(ok).all()
+    got = jcurve.g2_unpack(pt_dev)
+    assert got == pts
+
+
+def test_g1_decompress_matches_oracle():
+    rng = np.random.default_rng(2)
+    pts = _rand_g1(rng, 4) + [None]
+    raw = np.stack([np.frombuffer(refcurve.g1_to_bytes(p), np.uint8)
+                    for p in pts])
+    x, sign, inf, bad = codec.g1_bytes_split(raw)
+    assert not bad.any()
+    import jax.numpy as jnp
+    pt_dev, ok = codec.g1_decompress(jnp.asarray(x), jnp.asarray(sign),
+                                     jnp.asarray(inf))
+    assert np.asarray(ok).all()
+    assert jcurve.g1_unpack(pt_dev) == pts
+
+
+def test_g2_compress_matches_oracle():
+    rng = np.random.default_rng(3)
+    pts = _rand_g2(rng, 3) + [None]
+    packed = jcurve.g2_pack(pts)
+    import jax.numpy as jnp
+    xc0, xc1, yc0, yc1, inf = codec.g2_normalize(jnp.asarray(packed))
+    out = codec.g2_compress_np(*map(np.asarray, (xc0, xc1, yc0, yc1, inf)))
+    for row, p in zip(out, pts):
+        assert row.tobytes() == refcurve.g2_to_bytes(p)
+
+
+def test_g1_compress_matches_oracle():
+    rng = np.random.default_rng(4)
+    pts = _rand_g1(rng, 3) + [None]
+    packed = jcurve.g1_pack(pts)
+    import jax.numpy as jnp
+    x, y, inf = codec.g1_normalize(jnp.asarray(packed))
+    out = codec.g1_compress_np(np.asarray(x), np.asarray(y), np.asarray(inf))
+    for row, p in zip(out, pts):
+        assert row.tobytes() == refcurve.g1_to_bytes(p)
+
+
+def test_bad_encodings_rejected():
+    # not compressed
+    raw = np.zeros((1, 96), np.uint8)
+    assert codec.g2_bytes_split(raw)[4].all()
+    # x >= p
+    raw = np.zeros((1, 96), np.uint8)
+    raw[0, :48] = np.frombuffer((P % (1 << 381)).to_bytes(48, "big"), np.uint8)
+    raw[0, 0] |= 0x80
+    assert codec.g2_bytes_split(raw)[4].all()
+    # infinity with junk
+    raw = np.zeros((1, 96), np.uint8)
+    raw[0, 0] = 0xC0
+    raw[0, 50] = 7
+    assert codec.g2_bytes_split(raw)[4].all()
+    # x not on curve: sqrt must fail
+    import jax.numpy as jnp
+    bad_x = None
+    x = 5
+    while bad_x is None:
+        xf = FQ2([x, 0])
+        if (xf * xf * xf + refcurve.B2).sqrt() is None:
+            bad_x = x
+        x += 1
+    xc0 = np.stack([fp.to_limbs(bad_x)])
+    zero = np.zeros_like(xc0)
+    _, ok = codec.g2_decompress(jnp.asarray(xc0), jnp.asarray(zero),
+                                jnp.asarray([False]), jnp.asarray([False]))
+    assert not np.asarray(ok).any()
+
+
+def test_subgroup_checks_match_oracle():
+    """Cofactor (non-r-order) points must be rejected exactly like the
+    oracle deserialiser rejects them."""
+    import jax.numpy as jnp
+    from charon_tpu.tbls.ref.fields import R
+
+    # a G2 point NOT in the subgroup (oracle helper used by the derivation)
+    bad = codec._find_g2_cofactor_point()
+    assert refcurve.multiply_raw(bad, R) is not None
+    good = refcurve.multiply(refcurve.G2_GEN, 777)
+    pts = jcurve.g2_pack([good, bad, None])
+    ok = np.asarray(codec.g2_in_subgroup(jnp.asarray(pts)))
+    assert ok.tolist() == [True, False, True]
+
+    # G1: find an on-curve x whose point is not in the subgroup
+    x = 1
+    bad1 = None
+    while bad1 is None:
+        xf = FQ(x)
+        y = (xf * xf * xf + refcurve.B1).sqrt()
+        if y is not None and refcurve.multiply_raw((xf, y), R) is not None:
+            bad1 = (xf, y)
+        x += 1
+    good1 = refcurve.multiply(refcurve.G1_GEN, 99)
+    pts1 = jcurve.g1_pack([good1, bad1, None])
+    ok1 = np.asarray(codec.g1_in_subgroup(jnp.asarray(pts1)))
+    assert ok1.tolist() == [True, False, True]
+
+
+def test_decompress_rejects_cofactor_point_bytes():
+    """End-to-end: compressed bytes of an off-subgroup point fail
+    decompression ok-flag, like the oracle raising on subgroup check."""
+    import jax.numpy as jnp
+
+    bad = codec._find_g2_cofactor_point()
+    raw_bytes = refcurve.g2_to_bytes(bad)
+    with pytest.raises(ValueError):
+        refcurve.g2_from_bytes(raw_bytes)  # oracle rejects
+    raw = np.frombuffer(raw_bytes, np.uint8)[None]
+    xc0, xc1, sign, inf, bad_enc = codec.g2_bytes_split(raw)
+    assert not bad_enc.any()
+    _, ok = codec.g2_decompress(jnp.asarray(xc0), jnp.asarray(xc1),
+                                jnp.asarray(sign), jnp.asarray(inf))
+    assert not np.asarray(ok).any()

@@ -1,18 +1,23 @@
-"""p2p TCP mesh tests: framing/auth, request-response, parsigex exchange,
-and a full simnet cluster running over real localhost sockets."""
+"""p2p TCP mesh tests: handshake auth, encryption, request-response,
+parsigex exchange, and byzantine insider-forgery rejection on the
+consensus protocol (reference analogues: p2p/sender.go, p2p/gater.go,
+core/consensus/component.go:343-353)."""
 
 import asyncio
+import dataclasses
 import socket
 
 import pytest
 
+from charon_tpu.core import serialize
 from charon_tpu.core.qbft import Msg, MsgType
 from charon_tpu.core.types import (Duty, DutyType, ParSignedData,
                                    SignedRandao)
-from charon_tpu.p2p.protocols import P2PConsensusTransport, P2PParSigEx
-from charon_tpu.p2p.transport import Peer, TCPMesh
-
-SECRET = b"cluster-secret-for-tests"
+from charon_tpu.p2p import identity as ident
+from charon_tpu.p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
+                                      sign_consensus_msg,
+                                      verify_consensus_msg)
+from charon_tpu.p2p.transport import Peer, TCPMesh, new_test_identities
 
 
 def free_ports(n: int) -> list[int]:
@@ -27,10 +32,12 @@ def free_ports(n: int) -> list[int]:
     return ports
 
 
-def make_mesh(n: int, secret: bytes = SECRET):
+def make_mesh(n: int):
     ports = free_ports(n)
     peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(n)]
-    return [TCPMesh(i, peers, secret) for i in range(n)]
+    ids, pubs = new_test_identities(n)
+    return [TCPMesh(i, peers, ids[i], pubs, cluster_hash=b"test")
+            for i in range(n)]
 
 
 def test_send_receive_roundtrip():
@@ -54,14 +61,16 @@ def test_send_receive_roundtrip():
     asyncio.run(main())
 
 
-def test_bad_mac_dropped():
-    """Frames from a node with the wrong cluster secret are dropped
-    (conn-gater equivalent)."""
+def test_unknown_identity_rejected():
+    """A node whose identity key is not pinned in the cluster cannot
+    complete the handshake (conn-gater equivalent)."""
     async def main():
         ports = free_ports(2)
         peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(2)]
-        good = TCPMesh(0, peers, SECRET)
-        evil = TCPMesh(1, peers, b"wrong-secret")
+        ids, pubs = new_test_identities(2)
+        good = TCPMesh(0, peers, ids[0], pubs, cluster_hash=b"test")
+        evil_id = ident.NodeIdentity.generate(b"not-in-cluster")
+        evil = TCPMesh(1, peers, evil_id, pubs, cluster_hash=b"test")
         await good.start()
         await evil.start()
         try:
@@ -74,9 +83,44 @@ def test_bad_mac_dropped():
             await evil.send_async(0, "/t/x", b"evil payload")
             await asyncio.sleep(0.2)
             assert got == []
+            # the listener killed the connection after the failed handshake
+            ch = evil._channels.get(0)
+            assert ch is None or ch.reader.at_eof()
         finally:
             await good.stop()
             await evil.stop()
+    asyncio.run(main())
+
+
+def test_frames_encrypted_on_wire():
+    """DKG secret shares must not transit in plaintext: capture the raw
+    bytes written to the socket and assert the payload is absent."""
+    async def main():
+        meshes = make_mesh(2)
+        for m in meshes:
+            await m.start()
+        try:
+            got = []
+
+            async def handler(sender, payload):
+                got.append(payload)
+                return None
+            meshes[1].register_handler("/t/share", handler)
+
+            secret = b"SECRET-DKG-SHARE-0123456789abcdef"
+            ch = await meshes[0]._connect(1)
+            captured = []
+            orig_write = ch.writer.write
+            ch.writer.write = lambda data: (captured.append(data),
+                                            orig_write(data))[1]
+            await meshes[0].send_async(1, "/t/share", secret)
+            await asyncio.sleep(0.2)
+            assert got == [secret]
+            wire = b"".join(captured)
+            assert secret not in wire
+        finally:
+            for m in meshes:
+                await m.stop()
     asyncio.run(main())
 
 
@@ -111,8 +155,8 @@ def test_parsigex_over_sockets():
     asyncio.run(main())
 
 
-def test_consensus_transport_over_sockets():
-    """QBFT messages round-trip the wire with spoofed sources dropped."""
+def test_consensus_transport_signed_and_delivered():
+    """Properly signed QBFT messages round-trip the wire."""
     async def main():
         meshes = make_mesh(2)
         for m in meshes:
@@ -130,12 +174,72 @@ def test_consensus_transport_over_sockets():
             msg = Msg(MsgType.PRE_PREPARE, duty, source=0, round=1,
                       value=(("k", 1),))
             await t0.broadcast(duty, msg)
-            spoofed = Msg(MsgType.PRE_PREPARE, duty, source=1, round=1,
-                          value=(("k", 2),))  # claims to be from peer 1
-            await t0.broadcast(duty, spoofed)
             await asyncio.sleep(0.3)
             assert len(delivered) == 1
-            assert delivered[0][1] == msg
+            got = delivered[0][1]
+            assert got.signing_payload() == msg.signing_payload()
+            assert verify_consensus_msg(got, meshes[1].peer_pubkeys)
+        finally:
+            for m in meshes:
+                await m.stop()
+    asyncio.run(main())
+
+
+def test_insider_cannot_forge_peer_consensus_msg():
+    """THE byzantine-tolerance property (round-1 verdict item 5): a fully
+    valid cluster MEMBER (knows every shared secret, completes handshakes)
+    still cannot forge another member's consensus votes — directly or inside
+    a relayed justification."""
+    async def main():
+        meshes = make_mesh(3)
+        for m in meshes:
+            await m.start()
+        try:
+            transports = [P2PConsensusTransport(m) for m in meshes]
+            delivered = []
+
+            class FakeNode:
+                async def _deliver(self, duty, msg):
+                    delivered.append(msg)
+            transports[0].register(FakeNode())
+            duty = Duty(9, DutyType.ATTESTER)
+
+            # 1. insider 1 claims source=2 with its own (valid) signature:
+            forged = sign_consensus_msg(
+                Msg(MsgType.PREPARE, duty, source=2, round=1, value="v"),
+                meshes[1].identity)
+            await meshes[1].send_async(
+                0, "/charon_tpu/consensus/qbft/1.0.0",
+                serialize.encode_consensus_msg(duty, forged))
+
+            # 2. insider 1 embeds a forged justification from peer 2 inside
+            #    its OWN legitimately-signed round-change:
+            fake_prepare = sign_consensus_msg(
+                Msg(MsgType.PREPARE, duty, source=2, round=1, value="v"),
+                meshes[1].identity)  # signed by 1, claims 2
+            rc = sign_consensus_msg(
+                Msg(MsgType.ROUND_CHANGE, duty, source=1, round=2,
+                    prepared_round=1, prepared_value="v",
+                    justification=(fake_prepare,)),
+                meshes[1].identity)
+            await transports[1].broadcast(duty, rc)
+
+            await asyncio.sleep(0.3)
+            assert delivered == []  # both forgeries dropped
+
+            # 3. the same round-change with a GENUINE justification passes:
+            real_prepare = sign_consensus_msg(
+                Msg(MsgType.PREPARE, duty, source=2, round=1, value="v"),
+                meshes[2].identity)
+            rc_ok = sign_consensus_msg(
+                Msg(MsgType.ROUND_CHANGE, duty, source=1, round=2,
+                    prepared_round=1, prepared_value="v",
+                    justification=(real_prepare,)),
+                meshes[1].identity)
+            await transports[1].broadcast(duty, rc_ok)
+            await asyncio.sleep(0.3)
+            assert len(delivered) == 1
+            assert delivered[0].source == 1
         finally:
             for m in meshes:
                 await m.stop()

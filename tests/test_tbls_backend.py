@@ -1,0 +1,112 @@
+"""Unit tests for the TPU tbls backend — the file test_core_simnet.py cites.
+
+Covers the bytes-native device paths (decompress → MSM → compress), padding
+edges, invalid-signature rejection, and the api-level backend switch
+(reference semantics: tbls/tss.go:142-217).
+"""
+
+import numpy as np
+import pytest
+
+from charon_tpu.tbls import api
+from charon_tpu.tbls import shamir
+from charon_tpu.tbls.ref import bls, curve as refcurve
+from charon_tpu.tbls.ref.hash_to_curve import hash_to_g2
+
+
+@pytest.fixture(autouse=True)
+def _bls_tpu_backend():
+    api.set_scheme("bls")
+    api.set_backend("tpu")
+    yield
+    api.set_backend("cpu")
+
+
+def _partials(sk: int, msg: bytes, threshold: int, n: int):
+    """Split sk and produce partial signatures as wire bytes."""
+    shares, _ = shamir.split_secret(sk, threshold, n)
+    hm = hash_to_g2(msg)
+    return {i: refcurve.g2_to_bytes(refcurve.multiply(hm, s))
+            for i, s in shares.items()}
+
+
+def test_threshold_combine_bytes_matches_oracle():
+    msg = b"duty-attestation-42"
+    batch, expected = [], []
+    # deliberately non-power-of-two batch (3) with mixed share sets/sizes
+    for v, (t, n, idxs) in enumerate([(2, 3, (1, 3)), (3, 4, (2, 3, 4)),
+                                      (2, 2, (1, 2))]):
+        sk = 777 + v
+        parts = _partials(sk, msg, t, n)
+        batch.append({i: parts[i] for i in idxs})
+        expected.append(refcurve.g2_to_bytes(bls.sign(sk, msg)))
+    got = api.threshold_combine(batch)
+    assert got == expected
+
+
+def test_aggregate_via_api_entry_point():
+    sk = 31337
+    msg = b"hello tpu"
+    parts = _partials(sk, msg, 3, 5)
+    take = {i: parts[i] for i in (1, 2, 5)}
+    assert api.aggregate(take) == refcurve.g2_to_bytes(bls.sign(sk, msg))
+
+
+def test_batch_verify_bytes_accepts_and_rejects():
+    msgs = [b"m-a", b"m-b"]
+    sks = [1234, 5678]
+    entries = []
+    for sk, msg in zip(sks, msgs):
+        pk = refcurve.g1_to_bytes(bls.sk_to_pk(sk))
+        sig = refcurve.g2_to_bytes(bls.sign(sk, msg))
+        entries.append((pk, msg, sig))
+    # wrong message, wrong key, malformed sig, malformed pk
+    pk0 = refcurve.g1_to_bytes(bls.sk_to_pk(sks[0]))
+    sig0 = refcurve.g2_to_bytes(bls.sign(sks[0], msgs[0]))
+    entries.append((pk0, b"other-msg", sig0))
+    pk1 = refcurve.g1_to_bytes(bls.sk_to_pk(sks[1]))
+    entries.append((pk1, msgs[0], sig0))
+    entries.append((pk0, msgs[0], b"\x00" * 96))
+    entries.append((b"\x00" * 48, msgs[0], sig0))
+    got = api.batch_verify(entries)
+    assert got == [True, True, False, False, False, False]
+
+
+def test_infinity_signature_rejected():
+    sk = 999
+    pk = refcurve.g1_to_bytes(bls.sk_to_pk(sk))
+    inf_sig = refcurve.g2_to_bytes(None)
+    assert api.batch_verify([(pk, b"m", inf_sig)]) == [False]
+
+
+def test_combine_malformed_bytes_raises():
+    good = _partials(888, b"x", 2, 2)
+    with pytest.raises(ValueError):
+        api.threshold_combine([{1: good[1], 2: b"\xff" * 96}])
+
+
+def test_combine_off_curve_x_raises():
+    # craft an x that is a valid field element but not on the curve
+    from charon_tpu.tbls.ref.fields import FQ2
+    x = 5
+    while (FQ2([x, 0]) ** 3 + refcurve.B2).sqrt() is not None:
+        x += 1
+    bad = bytearray(x.to_bytes(48, "big") + b"\x00" * 48)
+    bad[0] |= 0x80
+    good = _partials(888, b"x", 2, 2)
+    with pytest.raises(ValueError):
+        api.threshold_combine([{1: good[1], 2: bytes(bad)}])
+
+
+def test_verify_and_aggregate_on_tpu_backend():
+    msg = b"verify-and-aggregate"
+    tss, shares = api.generate_tss(2, 3, seed=b"vat")
+    partials = {i: api.sign(s, msg) for i, s in shares.items()}
+    sig, used = api.verify_and_aggregate(tss, partials, msg)
+    assert len(used) == 2
+    assert api.verify(tss.group_pubkey, msg, sig)
+    # corrupt one partial: still succeeds with the remaining two
+    partials[1] = partials[1][:-1] + bytes([partials[1][-1] ^ 1])
+    sig2, used2 = api.verify_and_aggregate(tss, partials, msg)
+    assert 1 not in used2
+    assert api.verify(tss.group_pubkey, msg, sig2)
