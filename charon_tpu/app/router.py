@@ -1,0 +1,278 @@
+"""Validator-API HTTP router — the VC-facing surface of the node.
+
+Mirrors reference core/validatorapi/router.go:
+- intercepts the DV-aware endpoints and routes them to the ValidatorAPI
+  component (router.go:84-212),
+- maps pubshare ↔ group pubkey on the wire so the downstream VC only ever
+  sees its share key (validatorapi.go:980-1014): the validators and duties
+  endpoints rewrite group pubkeys to pubshares in responses, and pubshare
+  query ids to group ids in requests,
+- everything else is reverse-proxied verbatim to the upstream beacon node
+  (router.go:771-829).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+from aiohttp import web
+
+from ..core.types import PubKey
+from ..core.validatorapi import ValidatorAPI, VapiError
+from ..eth2util import beaconapi as api
+
+
+_HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
+                "keep-alive", "te", "trailers", "upgrade",
+                "proxy-authorization", "proxy-authenticate"}
+
+
+class VapiRouter:
+    """HTTP server in front of a ValidatorAPI component + reverse proxy."""
+
+    def __init__(self, vapi: ValidatorAPI, beacon_addr: str,
+                 pubkey_by_index=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        """`beacon_addr` is the upstream BN base URL for the proxy;
+        `pubkey_by_index` optionally resolves validator_index → group
+        PubKey (used by voluntary exits, reference SubmitVoluntaryExit)."""
+        self.vapi = vapi
+        self.beacon_addr = beacon_addr.rstrip("/")
+        self._pubkey_by_index = pubkey_by_index
+        self._host, self._port = host, port
+        self._runner: web.AppRunner | None = None
+        self._proxy_session: aiohttp.ClientSession | None = None
+        self.addr = ""
+        self.proxied: list[str] = []  # proxied request log (assertion point)
+
+        app = web.Application()
+        r = app.router
+        # -- intercepted (router.go:84-212) ---------------------------------
+        r.add_get("/eth/v1/validator/attestation_data", self._att_data)
+        r.add_post("/eth/v1/beacon/pool/attestations", self._submit_atts)
+        r.add_get("/eth/v2/validator/blocks/{slot}", self._block_proposal)
+        r.add_get("/eth/v1/validator/blinded_blocks/{slot}",
+                  self._block_proposal)
+        r.add_post("/eth/v1/beacon/blocks", self._submit_block)
+        r.add_post("/eth/v1/beacon/blinded_blocks", self._submit_block)
+        r.add_post("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
+        r.add_post("/eth/v1/validator/register_validator", self._submit_regs)
+        r.add_post("/eth/v1/validator/aggregate_and_proofs", self._submit_aggs)
+        r.add_get("/eth/v1/validator/aggregate_attestation", self._agg_att)
+        r.add_post("/eth/v1/beacon/pool/sync_committees", self._submit_sync)
+        r.add_post("/eth/v1/validator/contribution_and_proofs",
+                   self._submit_contribs)
+        r.add_post("/eth/v1/validator/beacon_committee_selections",
+                   self._bcomm_selections)
+        r.add_post("/eth/v1/validator/sync_committee_selections",
+                   self._sync_selections)
+        # -- pubkey-mapped passthroughs (validatorapi.go:980-1014) ----------
+        r.add_get("/eth/v1/beacon/states/{state}/validators",
+                  self._validators)
+        r.add_post("/eth/v1/beacon/states/{state}/validators",
+                   self._validators)
+        r.add_post("/eth/v1/validator/duties/attester/{epoch}",
+                   self._duties_mapped)
+        r.add_get("/eth/v1/validator/duties/proposer/{epoch}",
+                  self._duties_mapped)
+        r.add_post("/eth/v1/validator/duties/sync/{epoch}",
+                   self._duties_mapped)
+        # -- reverse proxy for the rest (router.go:771-829) -----------------
+        r.add_route("*", "/{tail:.*}", self._proxy)
+        app.middlewares.append(self._error_mw)
+        self._app = app
+
+    @web.middleware
+    async def _error_mw(self, request: web.Request, handler):
+        """Beacon-API error convention: {"code": N, "message": ...}
+        (reference: router.go writeError)."""
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise
+        except (VapiError, ValueError, KeyError) as e:
+            return web.json_response({"code": 400, "message": str(e)},
+                                     status=400)
+        except asyncio.TimeoutError:
+            return web.json_response({"code": 504, "message": "timeout"},
+                                     status=504)
+
+    async def start(self) -> None:
+        self._proxy_session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.addr = f"http://{self._host}:{port}"
+
+    async def stop(self) -> None:
+        if self._proxy_session is not None:
+            await self._proxy_session.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- helpers ------------------------------------------------------------
+
+    def _share_for_group(self, group_hex: str) -> str:
+        """group pubkey hex → this node's pubshare hex (response mapping)."""
+        pk = PubKey(group_hex)
+        share = self.vapi._pubshare_by_group.get(pk)
+        return api.hex_of(share) if share is not None else group_hex
+
+    def _group_for_share(self, share_hex: str) -> str:
+        """pubshare hex → group pubkey hex (request mapping)."""
+        try:
+            pk = self.vapi.group_pubkey_for_share(api.to_bytes(share_hex, 48))
+            return str(pk)
+        except (VapiError, ValueError):
+            return share_hex
+
+    # -- intercepted handlers -----------------------------------------------
+
+    async def _att_data(self, request) -> web.Response:
+        slot = int(request.query["slot"])
+        ci = int(request.query.get("committee_index", 0))
+        data = await self.vapi.attestation_data(slot, ci)
+        return web.json_response({"data": api.att_data_json(data)})
+
+    async def _submit_atts(self, request) -> web.Response:
+        atts = [api.attestation_from(d) for d in await request.json()]
+        await self.vapi.submit_attestations(atts)
+        return web.json_response({})
+
+    async def _block_proposal(self, request) -> web.Response:
+        slot = int(request.match_info["slot"])
+        randao = api.to_bytes(request.query["randao_reveal"])
+        graffiti = api.to_bytes(request.query.get("graffiti", "0x"))
+        block = await self.vapi.beacon_block_proposal(slot, randao, graffiti)
+        return web.json_response({"data": api.block_json(block),
+                                  "version": "charon_tpu/simple"})
+
+    async def _submit_block(self, request) -> web.Response:
+        block = api.signed_block_from(await request.json())
+        await self.vapi.submit_beacon_block(block)
+        return web.json_response({})
+
+    async def _submit_exit(self, request) -> web.Response:
+        exit_ = api.exit_from(await request.json())
+        if self._pubkey_by_index is None:
+            raise web.HTTPInternalServerError(text="no validator index map")
+        group_pk = await self._pubkey_by_index(exit_.message.validator_index)
+        await self.vapi.submit_voluntary_exit(exit_, group_pk)
+        return web.json_response({})
+
+    async def _submit_regs(self, request) -> web.Response:
+        regs = [api.registration_from(d) for d in await request.json()]
+        await self.vapi.submit_validator_registrations(regs)
+        return web.json_response({})
+
+    async def _submit_aggs(self, request) -> web.Response:
+        aggs = [api.agg_and_proof_from(d) for d in await request.json()]
+        await self.vapi.submit_aggregate_attestations(aggs)
+        return web.json_response({})
+
+    async def _agg_att(self, request) -> web.Response:
+        # aggregate is served from the DutyDB (consensus-agreed), mirroring
+        # vapi.AggregateBeaconCommitteeAttestation
+        slot = int(request.query["slot"])
+        root = api.to_bytes(request.query["attestation_data_root"], 32)
+        agg = await self.vapi._await_agg_attestation(slot, root)
+        return web.json_response({"data": api.attestation_json(agg)})
+
+    async def _submit_sync(self, request) -> web.Response:
+        msgs = [api.sync_msg_from(d) for d in await request.json()]
+        await self.vapi.submit_sync_committee_messages(msgs)
+        return web.json_response({})
+
+    async def _submit_contribs(self, request) -> web.Response:
+        cs = [api.contribution_and_proof_from(d) for d in await request.json()]
+        await self.vapi.submit_sync_contributions(cs)
+        return web.json_response({})
+
+    async def _bcomm_selections(self, request) -> web.Response:
+        sels = [api.bcomm_selection_from(d) for d in await request.json()]
+        out = await self.vapi.submit_beacon_committee_selections(sels)
+        return web.json_response(
+            {"data": [api.bcomm_selection_json(s) for s in out]})
+
+    async def _sync_selections(self, request) -> web.Response:
+        sels = [api.sync_selection_from(d) for d in await request.json()]
+        out = await self.vapi.submit_sync_committee_selections(sels)
+        return web.json_response(
+            {"data": [api.sync_selection_json(s) for s in out]})
+
+    # -- pubkey-mapped passthroughs ----------------------------------------
+
+    async def _validators(self, request) -> web.Response:
+        """Map pubshare ids → group ids upstream, group pubkeys → pubshares
+        downstream (reference: validatorapi.go getValidators pubshare
+        mapping)."""
+        state = request.match_info["state"]
+        if request.method == "POST":
+            body = await request.json()
+            ids = [self._group_for_share(i) if i.startswith("0x") else i
+                   for i in body.get("ids", [])]
+            upstream = await self._upstream_json(
+                "POST", f"/eth/v1/beacon/states/{state}/validators",
+                json_body={"ids": ids})
+        else:
+            params = dict(request.query)
+            if "id" in params:
+                params["id"] = ",".join(
+                    self._group_for_share(i) if i.startswith("0x") else i
+                    for i in params["id"].split(","))
+            upstream = await self._upstream_json(
+                "GET", f"/eth/v1/beacon/states/{state}/validators",
+                params=params)
+        for v in upstream.get("data", []):
+            v["validator"]["pubkey"] = self._share_for_group(
+                v["validator"]["pubkey"])
+        return web.json_response(upstream)
+
+    async def _duties_mapped(self, request) -> web.Response:
+        """Forward duties requests, rewriting group pubkeys → pubshares in
+        the response so the VC recognises its keys."""
+        path = request.path
+        if request.method == "POST":
+            upstream = await self._upstream_json(
+                "POST", path, json_body=await request.json())
+        else:
+            upstream = await self._upstream_json(
+                "GET", path, params=dict(request.query))
+        for d in upstream.get("data", []):
+            if "pubkey" in d:
+                d["pubkey"] = self._share_for_group(d["pubkey"])
+        return web.json_response(upstream)
+
+    async def _upstream_json(self, method: str, path: str,
+                             params: dict | None = None,
+                             json_body=None) -> dict:
+        url = self.beacon_addr + path
+        async with self._proxy_session.request(
+                method, url, params=params, json=json_body) as resp:
+            if resp.status != 200:
+                raise web.HTTPBadGateway(
+                    text=f"upstream {resp.status}: {await resp.text()}")
+            return await resp.json()
+
+    # -- reverse proxy ------------------------------------------------------
+
+    async def _proxy(self, request: web.Request) -> web.Response:
+        """Verbatim reverse proxy to the beacon node
+        (reference: router.go:771-829 proxyHandler)."""
+        self.proxied.append(f"{request.method} {request.path}")
+        url = self.beacon_addr + request.path_qs
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        body = await request.read()
+        async with self._proxy_session.request(
+                request.method, url, headers=headers,
+                data=body if body else None) as resp:
+            payload = await resp.read()
+            out_headers = {k: v for k, v in resp.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+            return web.Response(status=resp.status, body=payload,
+                                headers=out_headers)

@@ -333,6 +333,33 @@ class SignedBeaconCommitteeSelection:
 
 
 @dataclass(frozen=True)
+class SignedSyncCommitteeSelection:
+    """Sync-committee selection proof (DVT sync-aggregation pre-duty,
+    reference: core/signeddata.go SyncCommitteeSelection).  Signing root is
+    the SyncAggregatorSelectionData HTR (altair spec)."""
+
+    selection: spec.SyncCommitteeSelection
+
+    @property
+    def signature(self) -> bytes:
+        return self.selection.selection_proof
+
+    def set_signature(self, sig: bytes) -> "SignedSyncCommitteeSelection":
+        return SignedSyncCommitteeSelection(
+            self.selection.replace(selection_proof=sig))
+
+    def message_root(self) -> bytes:
+        return spec.SyncAggregatorSelectionData(
+            slot=self.selection.slot,
+            subcommittee_index=self.selection.subcommittee_index,
+        ).hash_tree_root()
+
+    def signing_info(self, slots_per_epoch: int) -> tuple[DomainName, int]:
+        return (DomainName.SYNC_COMMITTEE_SELECTION_PROOF,
+                self.selection.slot // slots_per_epoch)
+
+
+@dataclass(frozen=True)
 class SignedAggregateAndProofSD:
     agg: spec.SignedAggregateAndProof
 
@@ -391,6 +418,7 @@ class SignedSyncContributionAndProof:
 
 SignedData = Union[SignedAttestation, SignedBlock, SignedRandao, SignedExit,
                    SignedRegistration, SignedBeaconCommitteeSelection,
+                   SignedSyncCommitteeSelection,
                    SignedAggregateAndProofSD, SignedSyncMessage,
                    SignedSyncContributionAndProof]
 SignedDataSet = dict  # PubKey -> SignedData

@@ -22,6 +22,7 @@ from .types import (Duty, DutyType, ParSignedData, ParSignedDataSet, PubKey,
                     SignedAggregateAndProofSD, SignedAttestation,
                     SignedBeaconCommitteeSelection, SignedBlock, SignedExit,
                     SignedRandao, SignedRegistration, SignedSyncMessage,
+                    SignedSyncCommitteeSelection,
                     SignedSyncContributionAndProof, pubkey_from_bytes,
                     pubkey_to_bytes)
 
@@ -208,6 +209,39 @@ class ValidatorAPI:
             signed = SignedSyncMessage(message=msg)
             self._verify_partial(group_pk, signed)
             await self._push(duty, group_pk, signed)
+
+    async def submit_sync_contributions(
+            self, contribs: list[spec.SignedContributionAndProof]) -> None:
+        """VC submits signed contribution-and-proofs
+        (reference: validatorapi.go SubmitSyncCommitteeContributions)."""
+        for c in contribs:
+            slot = c.message.contribution.slot
+            duty = Duty(slot, DutyType.SYNC_CONTRIBUTION)
+            defset = await self._get_duty_definition(
+                Duty(slot, DutyType.SYNC_MESSAGE))
+            group_pk = _pubkey_by_validator_index(
+                defset, c.message.aggregator_index)
+            signed = SignedSyncContributionAndProof(contribution=c)
+            self._verify_partial(group_pk, signed)
+            await self._push(duty, group_pk, signed)
+
+    async def submit_sync_committee_selections(
+            self, selections: list[spec.SyncCommitteeSelection]
+    ) -> list[spec.SyncCommitteeSelection]:
+        """Partial sync-committee selection proofs in, threshold-aggregated
+        selections out (reference: validatorapi.go:864-914)."""
+        out = []
+        for sel in selections:
+            duty = Duty(sel.slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
+            defset = await self._get_duty_definition(
+                Duty(sel.slot, DutyType.SYNC_MESSAGE))
+            group_pk = _pubkey_by_validator_index(defset, sel.validator_index)
+            signed = SignedSyncCommitteeSelection(selection=sel)
+            self._verify_partial(group_pk, signed)
+            await self._push(duty, group_pk, signed)
+            agg = await self._await_agg_sig_db(duty, group_pk)
+            out.append(agg.selection)
+        return out
 
     # -- aggregate & proof --------------------------------------------------
 

@@ -180,6 +180,28 @@ class Bitlist(SSZType):
             return value
         return Bitlist.from_bools(list(value))
 
+    @staticmethod
+    def to_ssz_bytes(value) -> bytes:
+        """SSZ wire form with delimiter bit (the beacon-API hex payload)."""
+        data, n = Bitlist._normalise(value)
+        out = bytearray(data[: n // 8 + 1])
+        while len(out) < n // 8 + 1:
+            out.append(0)
+        out[n // 8] |= 1 << (n % 8)
+        return bytes(out)
+
+    @staticmethod
+    def from_ssz_bytes(raw: bytes) -> tuple[bytes, int]:
+        """Inverse of to_ssz_bytes: strip the delimiter bit."""
+        if not raw or raw[-1] == 0:
+            raise ValueError("bitlist missing delimiter bit")
+        top = raw[-1].bit_length() - 1  # delimiter position in last byte
+        n = (len(raw) - 1) * 8 + top
+        data = bytearray(raw)
+        data[-1] &= (1 << top) - 1  # clear the delimiter
+        payload = bytes(data[: n // 8 + 1]) if n else b"\x00"
+        return payload, n
+
     def serialize(self, value) -> bytes:
         data, n = self._normalise(value)
         out = bytearray(data[: n // 8 + 1])

@@ -13,9 +13,13 @@ reference tbls/tss.go:21-23).  Design constraints that picked this shape:
   `[..., 32]` int32, limb axis last, little-endian.  Everything is pure jnp +
   lax, jit/vmap/shard_map-safe: fixed trip counts, no data-dependent control
   flow, so XLA can fuse and tile freely.
-- Multiplication is Montgomery (R = 2^384) via a 32-step `lax.scan` that
-  shifts the accumulator down one limb per step — static shapes, no dynamic
-  slicing.
+- Multiplication is Montgomery (R = 2^384) in CONVOLUTION form: one outer
+  product + staircase anti-diagonal sums (O(1) depth) and Kogge-Stone
+  carries (O(log L) depth via lax.associative_scan).  Depth, not FLOPs, is
+  what bounds the 256-iteration scalar-mul loops on real hardware — the
+  earlier scan-based multiplier (32 sequential steps per product, 32-step
+  carry chains) made every combine latency-bound at ~1.6 s regardless of
+  batch size.
 
 Correctness oracle: charon_tpu.tbls.ref.fields (differential tests in
 tests/test_ops_fp.py), per SURVEY.md §4's CPU-vs-TPU differential-test rule.
@@ -40,6 +44,8 @@ DTYPE = jnp.int32
 R_MONT = pow(2, LIMB_BITS * NLIMBS, P)
 R2_INT = R_MONT * R_MONT % P
 N0INV = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+NPRIME_INT = (-pow(P, -1, 1 << (LIMB_BITS * NLIMBS))) % (
+    1 << (LIMB_BITS * NLIMBS))  # −p⁻¹ mod R (full width, for conv-Montgomery)
 
 
 # ---------------------------------------------------------------------------
@@ -79,27 +85,80 @@ R2 = to_limbs(R2_INT)
 
 
 # ---------------------------------------------------------------------------
-# Carry machinery
+# Carry machinery — LOW DEPTH (the perf-critical redesign)
+#
+# The previous implementation propagated carries with a 32-step lax.scan;
+# every field multiply therefore cost >64 sequential vector steps and the
+# 256-bit scalar-mul loops were wall-clock bound by depth, not compute
+# (measured ~1.6 s per combine regardless of batch).  Everything below is
+# O(log L) depth: a couple of data-parallel "partial carry" rounds squeeze
+# limbs to ≤ 2^12, then a Kogge-Stone boolean carry (associative_scan over
+# the standard generate/propagate semigroup) finishes exactly.
 # ---------------------------------------------------------------------------
 
-def carry(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Propagate (possibly negative) limb overflows; return (canonical limbs
-    in [0, 2^12), final carry).  Signed arithmetic-shift semantics make the
-    same scan serve as a borrow chain for subtraction."""
-    xs = jnp.moveaxis(x, -1, 0)
+def _shift_up(h: jnp.ndarray) -> jnp.ndarray:
+    """Move limb k → k+1, dropping the top limb (callers guarantee either a
+    zero top or mod-2^(12·W) semantics)."""
+    pad = [(0, 0)] * (h.ndim - 1) + [(1, 0)]
+    return jnp.pad(h[..., :-1], pad)
 
-    def step(c, xi):
-        v = xi + c
-        return v >> LIMB_BITS, v & MASK
 
-    c, ys = lax.scan(step, jnp.zeros(x.shape[:-1], DTYPE), xs)
-    return jnp.moveaxis(ys, 0, -1), c
+def _partial_carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
+    """Data-parallel carry rounds for NONNEGATIVE limbs: value is preserved
+    mod 2^(12·W).  Each round divides the excess by 2^12; see call sites
+    for the per-round bound proofs."""
+    for _ in range(rounds):
+        x = (x & MASK) + _shift_up(x >> LIMB_BITS)
+    return x
+
+
+def _ks_carry(v: jnp.ndarray) -> jnp.ndarray:
+    """Exact final carry for limbs in [0, 2^12] (i.e. ≤ 4096, so carries are
+    single bits): Kogge-Stone generate/propagate via associative_scan.
+    Output limbs canonical; overflow of the top limb is dropped (value mod
+    2^(12·W) — pad beforehand if the carry-out matters)."""
+    g = v > MASK            # generates (v == 4096; g and p are disjoint)
+    p = v == MASK           # propagates
+
+    def op(x, y):
+        gx, px = x
+        gy, py = y
+        return gy | (py & gx), px & py
+
+    gs, _ = lax.associative_scan(op, (g, p), axis=-1)
+    c_in = _shift_up(gs.astype(DTYPE))
+    return (v + c_in) & MASK
+
+
+def _canon(x: jnp.ndarray, rounds: int = 3) -> jnp.ndarray:
+    """Full canonicalisation of nonnegative limbs (each < 2^31 − 2^19):
+    after round 1 limbs < 2^12 + 2^19, round 2 < 2^12 + 2^8, round 3
+    ≤ 2^12 + 1 ≤ 4096 — then the boolean Kogge-Stone finishes exactly."""
+    return _ks_carry(_partial_carry(x, rounds))
+
+
+_COMP_P = (MASK - P_LIMBS).astype(np.int32)  # per-limb complement of p
+
+
+def _sub_limbs(x: jnp.ndarray, c_limbs: np.ndarray):
+    """(x − c) mod 2^384 via complement-add (no negative intermediates):
+    x + ~c + 1.  Returns (diff, x ≥ c).  x canonical, c a constant < 2^384.
+    The borrow is read from the carry OUT of the top limb, so inputs are
+    padded one limb before the carry and sliced after."""
+    comp = (MASK - c_limbs).astype(np.int32)
+    comp = comp.copy()
+    comp[0] += 1                                   # the +1 of two's complement
+    t = x + jnp.asarray(comp)                      # ≤ 2·4095 + 1 per limb
+    pad = [(0, 0)] * (t.ndim - 1) + [(0, 1)]
+    t = jnp.pad(t, pad)                            # room for the carry-out
+    t = _ks_carry(_partial_carry(t, 1))            # ≤ 4096 after 1 round
+    return t[..., :-1], t[..., -1] == 1
 
 
 def cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
     """Subtract p iff x ≥ p.  Input canonical limbs, value < 2p."""
-    d, borrow = carry(x - jnp.asarray(P_LIMBS))
-    return jnp.where((borrow < 0)[..., None], x, d)
+    d, ge = _sub_limbs(x, P_LIMBS)
+    return jnp.where(ge[..., None], d, x)
 
 
 # ---------------------------------------------------------------------------
@@ -107,13 +166,31 @@ def cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    s, _ = carry(a + b)
+    # limbs ≤ 8190 → one partial round leaves ≤ 4096; top limb of a+b is
+    # < 2^10 (381-bit values in a 384-bit span), so no carry escapes.
+    s = _ks_carry(_partial_carry(a + b, 1))
     return cond_sub_p(s)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    s, _ = carry(a - b + jnp.asarray(P_LIMBS))
-    return cond_sub_p(s)
+    # (a − b) mod p: complement-add gives (a − b) mod 2^384 plus the a ≥ b
+    # flag; when a < b add p back (mod 2^384 — the wrap cancels exactly).
+    d, ge = _sub_any(a, b)
+    dp = _ks_carry(_partial_carry(d + jnp.asarray(P_LIMBS), 1))
+    return jnp.where(ge[..., None], d, dp)
+
+
+_ONE_HOT0 = np.zeros(NLIMBS, np.int32)
+_ONE_HOT0[0] = 1
+
+
+def _sub_any(x: jnp.ndarray, y: jnp.ndarray):
+    """(x − y) mod 2^384 + (x ≥ y) for two tensors (complement-add)."""
+    t = x + (MASK - y) + jnp.asarray(_ONE_HOT0)
+    pad = [(0, 0)] * (t.ndim - 1) + [(0, 1)]
+    t = jnp.pad(t, pad)
+    t = _ks_carry(_partial_carry(t, 1))
+    return t[..., :-1], t[..., -1] == 1
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
@@ -139,44 +216,49 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return acc
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product a·b·R⁻¹ mod p.
+NPRIME_LIMBS = to_limbs(NPRIME_INT)
 
-    Overflow proof (int32): schoolbook column ≤ 32·(2^12−1)² < 2^29; during
-    reduction each surviving column gains ≤ 32 further m·p_j terms (< 2^29)
-    plus one ≤ 2^19 carry, so peak magnitude < 2^30 < 2^31.  The scan shifts
-    the accumulator down one limb per step, keeping shapes static.
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray, out_cols: int) -> jnp.ndarray:
+    """Schoolbook column sums Σ_{i+j=k} aᵢ·bⱼ in O(1) depth: one outer
+    product, then the pad/flatten/reshape staircase that shifts row i right
+    by i positions, then a single row-sum.  All shapes static; pure VPU."""
+    L = a.shape[-1]
+    outer = a[..., :, None] * b[..., None, :]          # [..., L, L]
+    pad = [(0, 0)] * (outer.ndim - 2) + [(0, 0), (0, L)]
+    flat = jnp.pad(outer, pad).reshape(*outer.shape[:-2], 2 * L * L)
+    shifted = flat[..., : L * (2 * L - 1)].reshape(
+        *outer.shape[:-2], L, 2 * L - 1)               # row i shifted by i
+    return shifted.sum(axis=-2)[..., :out_cols]
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a·b·R⁻¹ mod p — conv-form, O(log) depth.
+
+    Steps (int32 overflow bounds inline; inputs canonical 12-bit limbs):
+      t  = a ⊛ b                  63 cols, ≤ 32·2^24 = 2^29
+      tl = pc₂(t mod R)           limbs ≤ 2^12 + 2^7 < 2^13
+      m  = pc₂((tl ⊛ n′) mod R)   cols ≤ 32·2^25 = 2^30 → limbs < 2^13
+      u  = t + m ⊛ p              ≤ 2^29 + 2^30 < 2^31
+      res = canon(u) / R          low 32 cols vanish (u ≡ 0 mod R)
+    m's integer value may slightly exceed R (limbs ≤ 2^12+2^7, so
+    m < R(1+2⁻⁵)); res < p²/R + (1+2⁻⁵)p < p/8 + 1.04p < 2p — one
+    conditional subtraction finishes.
     """
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
-    # Schoolbook convolution as a 32-step scan (compact HLO: the pairing
-    # kernels contain tens of thousands of these): step i adds aᵢ·(b << i).
-    b_pad = jnp.concatenate([b, jnp.zeros_like(b)], axis=-1)
 
-    def conv_step(state, a_i):
-        acc, bs = state
-        acc = acc + a_i[..., None] * bs
-        return (acc, jnp.roll(bs, 1, axis=-1)), None
-
-    (prod, _), _ = lax.scan(
-        conv_step,
-        (jnp.zeros(shape[:-1] + (2 * NLIMBS,), DTYPE), b_pad),
-        jnp.moveaxis(a, -1, 0))
-
-    p_pad = jnp.asarray(P_PAD)
-
-    def step(t, _):
-        m = ((t[..., 0] & MASK) * N0INV) & MASK
-        t = t + m[..., None] * p_pad
-        c = t[..., 0] >> LIMB_BITS
-        t = jnp.concatenate([t[..., 1:], jnp.zeros_like(t[..., :1])], axis=-1)
-        t = t.at[..., 0].add(c)
-        return t, None
-
-    t, _ = lax.scan(step, prod, None, length=NLIMBS)
-    lo, _ = carry(t[..., :NLIMBS])  # value < 2p ⇒ no final carry
-    return cond_sub_p(lo)
+    t = _conv(a, b, 2 * NLIMBS - 1)                    # [..., 63] ≤ 2^29
+    tl = _partial_carry(t[..., :NLIMBS], 2)            # ≡ t mod R, < 2^13
+    m_cols = _conv(tl, jnp.asarray(NPRIME_LIMBS), NLIMBS)      # ≤ 2^30
+    m = _partial_carry(m_cols, 2)                      # < 2^13
+    mp = _conv(m, jnp.asarray(P_LIMBS), 2 * NLIMBS - 1)        # ≤ 2^30
+    u = t + mp                                         # < 2^31
+    pad = [(0, 0)] * (u.ndim - 1) + [(0, 1)]
+    u = _canon(jnp.pad(u, pad))                        # 64 canonical limbs
+    res = u[..., NLIMBS:]                              # exact u / R, < 2p
+    return cond_sub_p(res)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -259,8 +341,11 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(cond[..., None], a, b)
 
 
+_HALF_P1 = to_limbs((P + 1) // 2)
+
+
 def sgn(a_std: jnp.ndarray) -> jnp.ndarray:
     """Lexicographic sign of a STANDARD-form element (ZCash serialisation):
     1 iff a > (p−1)/2, i.e. iff a ≥ (p+1)/2.  Mirrors ref.fields.FQ.sgn."""
-    _, borrow = carry(a_std - jnp.asarray(to_limbs((P + 1) // 2)))
-    return borrow >= 0
+    _, ge = _sub_limbs(a_std, _HALF_P1)
+    return ge

@@ -38,6 +38,13 @@ class ProposerDutyInfo:
     slot: int
 
 
+@dataclass
+class SyncDutyInfo:
+    pubkey: bytes
+    validator_index: int
+    sync_committee_indices: list[int]
+
+
 class BeaconMock:
     def __init__(self, validators: dict[PubKey, spec.Validator] | None = None,
                  slot_duration: float = 1.0, slots_per_epoch: int = 16,
@@ -141,6 +148,25 @@ class BeaconMock:
             if v is not None:
                 out.append(ProposerDutyInfo(pubkey=v.pubkey,
                                             validator_index=idx, slot=slot))
+        return out
+
+    async def sync_duties(self, epoch: int,
+                          indices: list[int]) -> list[SyncDutyInfo]:
+        """Every cluster validator sits in the sync committee (simnet
+        convention; the reference beaconmock does the same via its
+        deterministic-duties option, options.go:340-381)."""
+        ov = await self._maybe_override("sync_duties", epoch, indices)
+        if ov is not None:
+            return ov
+        out = []
+        by_index = {v.index: v for v in self.validators.values()}
+        for idx in sorted(indices):
+            v = by_index.get(idx)
+            if v is None:
+                continue
+            out.append(SyncDutyInfo(
+                pubkey=v.pubkey, validator_index=idx,
+                sync_committee_indices=[idx % 512]))
         return out
 
     # -- duty data ----------------------------------------------------------
