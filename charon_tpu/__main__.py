@@ -1,0 +1,7 @@
+"""python -m charon_tpu — CLI entry point (reference: main.go:23)."""
+
+import sys
+
+from .cmd import main
+
+sys.exit(main())

@@ -1,0 +1,437 @@
+"""app.run — the full node assembly (reference: app/app.go:127-488).
+
+Everything the reference's `app.Run` wires is assembled here from cluster
+material on disk:
+
+    lock file → peers/identity → TCP mesh (authenticated-encrypted)
+    beacon URLs → MultiBeaconClient (first-success fan-out)
+    core workflow components + core.wire() with async-retry wrapped edges
+    Deadliner → duty-expiry GC for dutydb/parsigdb/aggsigdb/consensus/
+        scheduler + post-deadline tracker analysis
+    tracker, peerinfo gossip loop, ping loop, monitoring API (/readyz =
+        quorum-peers AND BN-synced, app/monitoringapi.go:100-176),
+    priority/infosync exchange triggered at the last slot of each epoch,
+    validator-API HTTP router with reverse proxy,
+    ordered start/stop via lifecycle.Manager.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ..cluster.definition import Lock, lock_from_json, load_json
+from ..core import interfaces
+from ..core.aggsigdb import MemAggSigDB
+from ..core.bcast import Broadcaster, Recaster
+from ..core.consensus import QBFTConsensus
+from ..core.deadline import Deadliner, duty_deadline
+from ..core.dutydb import MemDutyDB
+from ..core.fetcher import Fetcher
+from ..core.parsigdb import MemParSigDB
+from ..core.priority import InfoSync, Prioritiser
+from ..core.scheduler import Scheduler
+from ..core.sigagg import SigAgg
+from ..core.tracker import Tracker
+from ..core.types import (Duty, DutyType, ParSignedDataSet, PubKey,
+                          pubkey_from_bytes)
+from ..core.validatorapi import ValidatorAPI
+from ..eth2util.beacon_client import MultiBeaconClient
+from ..eth2util.signing import signing_root
+from ..p2p import identity as ident
+from ..p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
+                             P2PPriorityExchange)
+from ..p2p.transport import TCPMesh, mesh_params_from_definition
+from ..tbls import api as tbls
+from . import featureset
+from .lifecycle import Manager, StartOrder, StopOrder
+from .monitoring import MonitoringAPI, Registry
+from .peerinfo import PeerInfo
+from .retry import Retryer, with_async_retry
+from .router import VapiRouter
+
+VERSION = "charon-tpu/0.3.0"
+SUPPORTED_PROTOCOLS = ["/charon_tpu/consensus/qbft/1.0.0",
+                       "/charon_tpu/leadercast/1.0.0"]
+
+
+@dataclass
+class RunConfig:
+    """reference: app.Config (app/app.go:60-97)."""
+
+    lock_file: str
+    identity_key_file: str
+    beacon_urls: list[str]
+    vapi_host: str = "127.0.0.1"
+    vapi_port: int = 0
+    monitoring_host: str = "127.0.0.1"
+    monitoring_port: int = 0
+    builder_api: bool = False
+    no_verify_lock: bool = False
+    simnet_vmock: bool = False
+    keystore_dir: str = ""          # share-key keystores for the vmock
+    features_enabled: list[str] = field(default_factory=list)
+    features_disabled: list[str] = field(default_factory=list)
+    ping_interval: float = 5.0
+    peerinfo_interval: float = 10.0
+
+
+class App:
+    """A fully-wired running node; also the TestConfig-style handle tests
+    use to reach into components (reference: app/app.go:99-122)."""
+
+    def __init__(self, cfg: RunConfig):
+        self.cfg = cfg
+        self.life = Manager()
+        self.lock: Lock | None = None
+        self.mesh: TCPMesh | None = None
+        self.monitoring: MonitoringAPI | None = None
+        self.router: VapiRouter | None = None
+        self.tracker: Tracker | None = None
+        self.registry = Registry()
+        self._stop = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    # -- assembly -----------------------------------------------------------
+
+    async def setup(self) -> None:
+        cfg = self.cfg
+        featureset.init(featureset.Status.BETA,
+                        enabled=cfg.features_enabled,
+                        disabled=cfg.features_disabled)
+
+        # 1. cluster material (reference: app/app.go:150 loadLock)
+        self.lock = lock_from_json(load_json(cfg.lock_file),
+                                   verify=not cfg.no_verify_lock)
+        definition = self.lock.definition
+        n = definition.num_operators
+        threshold = definition.threshold
+        cluster_hash = self.lock.lock_hash
+
+        # 2. identity + self index from the lock ENRs (app/app.go:162-178)
+        with open(cfg.identity_key_file) as f:
+            identity = ident.NodeIdentity.from_bytes(
+                bytes.fromhex(f.read().strip()))
+        peers, pubs = mesh_params_from_definition(definition)
+        self_index = next((i for i, pub in pubs.items()
+                           if pub == identity.pubkey), None)
+        if self_index is None:
+            raise ValueError("identity key does not match any operator ENR")
+        self.self_index = self_index
+        share_idx = self_index + 1
+
+        # 3. transports
+        self.mesh = TCPMesh(self_index, peers, identity, pubs,
+                            cluster_hash=cluster_hash)
+        self.mesh.enable_ping_responder()
+
+        # 4. beacon client + chain parameters
+        self.eth2cl = MultiBeaconClient.from_urls(cfg.beacon_urls)
+        spec = await self.eth2cl.spec()
+        self.slot_duration = spec["SECONDS_PER_SLOT"]
+        self.slots_per_epoch = spec["SLOTS_PER_EPOCH"]
+        self.genesis_time = await self.eth2cl.genesis_time()
+        gvr = await self.eth2cl.genesis_validators_root()
+        fork = definition.fork_version
+
+        # 5. metrics registry with cluster identity labels (app/app.go:198)
+        self.registry.const_labels.update({
+            "cluster_hash": cluster_hash.hex()[:10],
+            "cluster_name": definition.name,
+            "peer": f"node{self_index}",
+        })
+        self.registry.set_gauge("app_peers", n)
+        self.registry.set_gauge("app_threshold", threshold)
+        self.registry.set_gauge("app_validators",
+                                definition.num_validators)
+
+        # 6. pubshare maps from the lock (app/app.go:327-376)
+        pubshares_by_peer: dict[int, dict[PubKey, bytes]] = {
+            i + 1: {pubkey_from_bytes(v.public_key): v.public_shares[i]
+                    for v in self.lock.validators}
+            for i in range(n)}
+        pubshares = pubshares_by_peer[share_idx]
+        self._pubshares_by_peer = pubshares_by_peer
+        self._fork, self._gvr = fork, gvr
+
+        # 7. core components
+        sched = Scheduler(self.eth2cl, list(pubshares),
+                          builder_api=cfg.builder_api)
+        fetcher = Fetcher(self.eth2cl)
+        consensus = QBFTConsensus(P2PConsensusTransport(self.mesh),
+                                  self_index, n)
+        dutydb = MemDutyDB()
+        vapi = ValidatorAPI(share_idx=share_idx,
+                            pubshare_by_group=pubshares,
+                            fork_version=fork,
+                            genesis_validators_root=gvr,
+                            slots_per_epoch=self.slots_per_epoch)
+        parsigdb = MemParSigDB(threshold)
+        parsigex = P2PParSigEx(self.mesh, verify_fn=self._verify_external)
+        sigagg = SigAgg(threshold)
+        aggsigdb = MemAggSigDB()
+        bcast = Broadcaster(self.eth2cl, self.genesis_time,
+                            self.slot_duration,
+                            registry=self.registry)
+        recaster = Recaster()
+
+        deadline_fn = lambda duty: duty_deadline(  # noqa: E731
+            duty, self.genesis_time, self.slot_duration)
+        self.deadliner = Deadliner(deadline_fn)
+        self.retryer = Retryer(deadline_fn)
+
+        interfaces.wire(sched, fetcher, consensus, dutydb, vapi, parsigdb,
+                        parsigex, sigagg, aggsigdb, bcast,
+                        with_async_retry(self.retryer))
+        sigagg.subscribe(recaster.store)
+        sched.subscribe_slots(recaster.slot_ticked)
+        recaster.subscribe(bcast.broadcast)
+
+        self.scheduler, self.dutydb, self.parsigdb = sched, dutydb, parsigdb
+        self.aggsigdb, self.consensus, self.vapi = aggsigdb, consensus, vapi
+        self.bcast = bcast
+
+        # 8. tracker rides every edge as an extra subscriber
+        #    (reference: app/app.go:450 wireTracker)
+        self.tracker = Tracker(num_peers=n, threshold=threshold)
+        sched.subscribe_duties(self.tracker.on_duty_scheduled)
+        fetcher.subscribe(self.tracker.on_fetched)
+        consensus.subscribe(self.tracker.on_consensus)
+        parsigdb.subscribe_internal(self.tracker.on_parsig_internal)
+        parsigex.subscribe(self.tracker.on_parsig_external)
+        parsigdb.subscribe_threshold(self.tracker.on_threshold)
+        sigagg.subscribe(self.tracker.on_aggregated)
+        self.tracker.subscribe(self._on_duty_report)
+
+        # 9. deadliner feeds: every scheduled/inbound duty gets a deadline
+        async def _register_deadline(duty: Duty, *_args) -> None:
+            self.deadliner.add(duty)
+
+        sched.subscribe_duties(_register_deadline)
+        parsigex.subscribe(_register_deadline)
+        consensus.subscribe(_register_deadline)
+
+        # 10. priority / infosync over the mesh (app/app.go:515-524)
+        self.priority_exchange = P2PPriorityExchange(self.mesh)
+        prioritiser = Prioritiser(
+            self_index, n, self.priority_exchange.exchange,
+            consensus_propose=consensus.propose_priority,
+            consensus_subscribe=consensus.subscribe_priority)
+        self.infosync = InfoSync(prioritiser, versions=[VERSION],
+                                 protocols=SUPPORTED_PROTOCOLS)
+        self.priority_exchange.register_local(self.infosync.local_msg)
+        if featureset.enabled("priority"):
+            sched.subscribe_slots(self.infosync.on_slot)
+
+        # 11. peerinfo + monitoring
+        self.peerinfo = PeerInfo(self.mesh, VERSION, cluster_hash,
+                                 interval=cfg.peerinfo_interval)
+        self.monitoring = MonitoringAPI(self.registry, self._readyz,
+                                        identity=identity.enr())
+
+        # 12. validator-API HTTP router (reverse proxy → first beacon URL)
+        self._index_to_pubkey: dict[int, PubKey] = {}
+        self.router = VapiRouter(vapi, cfg.beacon_urls[0],
+                                 pubkey_by_index=self._pubkey_by_index,
+                                 host=cfg.vapi_host, port=cfg.vapi_port)
+
+        # 13. optional in-process validator mock (simnet,
+        #     reference: app/vmock.go)
+        self.vmock = None
+        if cfg.simnet_vmock:
+            from ..testutil.validatormock import ValidatorMock
+
+            keys = self._load_vmock_keys(cfg.keystore_dir, pubshares)
+            self.vmock = ValidatorMock(vapi, keys, fork,
+                                       genesis_validators_root=gvr,
+                                       slots_per_epoch=self.slots_per_epoch)
+            sched.subscribe_slots(self.vmock.on_slot)
+
+        self._register_lifecycle()
+
+    # -- hooks --------------------------------------------------------------
+
+    async def _verify_external(self, duty: Duty,
+                               pset: ParSignedDataSet) -> None:
+        """Inbound peer partial-sig verification against the SENDER's
+        pubshare (reference: core/parsigex/parsigex.go:152-176)."""
+        for group_pk, psig in pset.items():
+            peer_shares = self._pubshares_by_peer.get(psig.share_idx)
+            if peer_shares is None or group_pk not in peer_shares:
+                raise ValueError(f"unknown sender share {psig.share_idx}")
+            domain, _ = psig.data.signing_info(self.slots_per_epoch)
+            root = signing_root(domain, psig.data.message_root(),
+                                self._fork, self._gvr)
+            if not tbls.verify(peer_shares[group_pk], root, psig.signature):
+                raise ValueError("invalid external partial signature")
+
+    async def _pubkey_by_index(self, index: int) -> PubKey:
+        if not self._index_to_pubkey:
+            pks = [pubkey_from_bytes(v.public_key)
+                   for v in self.lock.validators]
+            vals = await self.eth2cl.active_validators(pks)
+            self._index_to_pubkey = {v.index: pk for pk, v in vals.items()}
+        return self._index_to_pubkey[index]
+
+    async def _on_duty_report(self, report) -> None:
+        self.registry.inc("core_tracker_duty_total",
+                          labels={"ok": str(report.success).lower()})
+        if not report.success:
+            import logging
+
+            logging.getLogger("charon_tpu.tracker").warning(
+                "duty %s failed at %s: %s", report.duty,
+                report.failed_step, report.reason)
+
+    def _readyz(self) -> tuple[bool, str]:
+        """Quorum peers reachable AND beacon node synced
+        (reference: app/monitoringapi.go:100-176)."""
+        n = self.lock.definition.num_operators
+        quorum = (2 * n) // 3 + 1
+        fresh = 1 + sum(1 for p, t in self._ping_ok.items()
+                        if time.time() - t < 3 * self.cfg.ping_interval)
+        if fresh < quorum:
+            return False, f"only {fresh}/{quorum} quorum peers reachable"
+        if not self._bn_synced:
+            return False, "beacon node not synced"
+        return True, "ok"
+
+    def _load_vmock_keys(self, keystore_dir: str,
+                         pubshares: dict[PubKey, bytes]):
+        """Map decrypted share keys to group pubkeys by matching pubshares
+        (the keystores hold SHARE private keys, docs/dkg.md:62-69)."""
+        from ..eth2util import keystore
+
+        secrets = keystore.load_keys(keystore_dir)
+        by_pubshare = {ps: gpk for gpk, ps in pubshares.items()}
+        out = {}
+        for sk in secrets:
+            pk = tbls.privkey_to_pubkey(sk)
+            gpk = by_pubshare.get(pk)
+            if gpk is not None:
+                out[gpk] = sk
+        if len(out) != len(pubshares):
+            raise ValueError(
+                f"keystores cover {len(out)}/{len(pubshares)} validators")
+        return out
+
+    # -- background loops ---------------------------------------------------
+
+    async def _gc_loop(self) -> None:
+        """Duty-expiry GC: trim every stateful component + run the tracker's
+        post-deadline analysis (reference: app wires Deadliner through
+        dutydb/parsigdb/consensus; core/deadline.go:30-160)."""
+        async for duty in self.deadliner.expired():
+            self.dutydb.trim(duty)
+            self.parsigdb.trim(duty)
+            self.aggsigdb.trim(duty)
+            self.consensus.trim(duty)
+            self.scheduler.trim(duty)
+            await self.tracker.analyse(duty)
+
+    async def _ping_loop(self) -> None:
+        while True:
+            for peer in list(self.mesh.peers):
+                try:
+                    rtt = await self.mesh.ping(peer)
+                    self._ping_ok[peer] = time.time()
+                    self.registry.observe("p2p_ping_rtt_seconds", rtt,
+                                          labels={"peer": str(peer)})
+                except Exception:
+                    pass
+            await asyncio.sleep(self.cfg.ping_interval)
+
+    async def _bn_sync_loop(self) -> None:
+        while True:
+            try:
+                s = await self.eth2cl.node_syncing()
+                self._bn_synced = not s["is_syncing"]
+            except Exception:
+                self._bn_synced = False
+            await asyncio.sleep(5.0)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _register_lifecycle(self) -> None:
+        life = self.life
+        self._ping_ok: dict[int, float] = {}
+        self._bn_synced = True
+
+        life.register_start(StartOrder.TRACKER, "deadliner",
+                            self._start_deadliner)
+        life.register_start(StartOrder.P2P_ROUTERS, "p2p-mesh",
+                            self.mesh.start)
+        life.register_start(StartOrder.P2P_PING, "ping-loop",
+                            self._ping_loop, background=True)
+        life.register_start(StartOrder.P2P_PING, "bn-sync-loop",
+                            self._bn_sync_loop, background=True)
+        life.register_start(StartOrder.P2P_PING, "peerinfo",
+                            self._start_peerinfo)
+        life.register_start(StartOrder.MONITOR_API, "monitoring",
+                            self._start_monitoring)
+        life.register_start(StartOrder.VALIDATOR_API, "vapi-router",
+                            self.router.start)
+        life.register_start(StartOrder.SCHEDULER, "gc-loop", self._gc_loop,
+                            background=True)
+        life.register_start(StartOrder.SCHEDULER, "scheduler",
+                            self.scheduler.run, background=True)
+
+        life.register_stop(StopOrder.SCHEDULER, "scheduler",
+                           self._stop_scheduler)
+        life.register_stop(StopOrder.RETRYER, "retryer",
+                           self.retryer.shutdown)
+        life.register_stop(StopOrder.VALIDATOR_API, "vapi-router",
+                           self.router.stop)
+        life.register_stop(StopOrder.P2P, "p2p-mesh", self.mesh.stop)
+        life.register_stop(StopOrder.P2P, "beacon-client",
+                           self.eth2cl.close)
+        life.register_stop(StopOrder.MONITOR_API, "monitoring",
+                           self._stop_monitoring)
+
+    async def _start_deadliner(self) -> None:
+        self.deadliner.start()
+
+    async def _start_peerinfo(self) -> None:
+        self.peerinfo.start()
+
+    async def _start_monitoring(self) -> None:
+        await self.monitoring.start(self.cfg.monitoring_host,
+                                    self.cfg.monitoring_port)
+
+    async def _stop_monitoring(self) -> None:
+        await self.monitoring.stop()
+        self.deadliner.stop()
+
+    async def _stop_scheduler(self) -> None:
+        self.scheduler.stop()
+
+    # -- public -------------------------------------------------------------
+
+    async def run(self) -> None:
+        """Assemble and run until stop() (reference: app/app.go:236)."""
+        await self.setup()
+        runner = asyncio.ensure_future(self.life.run())
+        await self._stop.wait()
+        self.life.stop()
+        await runner
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+async def run(cfg: RunConfig, started=None) -> None:
+    """Run one node to completion.  `started` (optional asyncio.Event) is
+    set once all lifecycle start hooks completed — tests use it to gate."""
+    app = App(cfg)
+    await app.setup()
+    runner = asyncio.ensure_future(app.life.run())
+    if started is not None:
+        # mesh/router ports are bound synchronously in start hooks which run
+        # before the lifecycle blocks; yield until the router has an addr
+        while not app.router.addr:
+            await asyncio.sleep(0.01)
+        started.set()
+    await app._stop.wait()
+    app.life.stop()
+    await runner

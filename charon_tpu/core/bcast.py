@@ -15,10 +15,12 @@ from .types import (Duty, DutyType, PubKey, SignedData, SignedDataSet,
 
 
 class Broadcaster:
-    def __init__(self, eth2cl, genesis_time: float, slot_duration: float):
+    def __init__(self, eth2cl, genesis_time: float, slot_duration: float,
+                 registry=None):
         self._eth2cl = eth2cl
         self._genesis = genesis_time
         self._slot_duration = slot_duration
+        self._registry = registry  # app.monitoring.Registry (optional)
         self.broadcast_delays: list[tuple[Duty, float]] = []  # metric feed
 
     async def broadcast(self, duty: Duty, pubkey: PubKey,
@@ -50,6 +52,11 @@ class Broadcaster:
             raise ValueError(f"unsupported duty type {t}")
         delay = time.time() - (self._genesis + duty.slot * self._slot_duration)
         self.broadcast_delays.append((duty, delay))
+        if self._registry is not None:
+            self._registry.observe("core_bcast_delay_seconds", delay,
+                                   labels={"duty": duty.type.name.lower()})
+            self._registry.inc("core_bcast_broadcast_total",
+                               labels={"duty": duty.type.name.lower()})
 
 
 class Recaster:

@@ -63,6 +63,7 @@ class QBFTConsensus:
         self._base = round_timeout_base
         self._inc = round_timeout_inc
         self._subs: list = []
+        self._prio_subs: list = []
         self._queues: dict[Duty, asyncio.Queue] = {}
         self._tasks: dict[Duty, asyncio.Task] = {}
         self._decided: set[Duty] = set()
@@ -71,6 +72,13 @@ class QBFTConsensus:
 
     def subscribe(self, fn) -> None:
         self._subs.append(fn)
+
+    def subscribe_priority(self, fn) -> None:
+        """Decisions for INFO_SYNC duties (priority-protocol values) go to
+        these subscribers instead of the duty pipeline
+        (reference: core/consensus Component handles PriorityResult values,
+        component.go:252-254)."""
+        self._prio_subs.append(fn)
 
     # -- duty instance management ------------------------------------------
 
@@ -86,6 +94,10 @@ class QBFTConsensus:
             if duty in self._decided:
                 return
             self._decided.add(duty)
+            if duty.type == DutyType.INFO_SYNC:
+                for fn in self._prio_subs:
+                    await fn(duty, value)
+                return
             for fn in self._subs:
                 await fn(duty, from_value(value))
 
@@ -125,6 +137,11 @@ class QBFTConsensus:
     async def propose(self, duty: Duty, unsigned: UnsignedDataSet) -> None:
         """Start (or join) this duty's consensus with our proposed value."""
         self._ensure_instance(duty, to_value(unsigned))
+
+    async def propose_priority(self, duty: Duty, value: Any) -> None:
+        """Propose a raw hashable value (priority-protocol results) for an
+        INFO_SYNC duty."""
+        self._ensure_instance(duty, value)
 
     async def _deliver(self, duty: Duty, msg: qbft.Msg) -> None:
         # Stragglers for GC'd duties are dropped, not re-buffered.

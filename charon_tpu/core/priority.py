@@ -61,6 +61,13 @@ def calculate_result(msgs: list[PriorityMsg], quorum: int) -> tuple[TopicResult,
     return tuple(out)
 
 
+def local_priority_msg(peer_idx: int, slot: int, topics: dict) -> PriorityMsg:
+    """Canonical (sorted, tuple-ised) PriorityMsg for this peer+slot."""
+    return PriorityMsg(peer_idx=peer_idx, slot=slot,
+                       topics=tuple((t, tuple(p))
+                                    for t, p in sorted(topics.items())))
+
+
 class Prioritiser:
     """reference: core/priority/prioritiser.go NewComponent."""
 
@@ -87,20 +94,17 @@ class Prioritiser:
 
     async def prioritise(self, slot: int, topics: dict) -> None:
         """Submit our preferences and drive agreement for this slot."""
-        msg = PriorityMsg(peer_idx=self._peer_idx, slot=slot,
-                          topics=tuple((t, tuple(p))
-                                       for t, p in sorted(topics.items())))
+        msg = local_priority_msg(self._peer_idx, slot, topics)
         msgs = await self._exchange(msg)
         result = calculate_result(msgs, self.quorum)
         duty = Duty(slot, DutyType.INFO_SYNC)
-        await self._propose(duty, {"priority": result})
+        await self._propose(duty, result)
 
     async def _on_decided(self, duty: Duty, value) -> None:
         if duty.type != DutyType.INFO_SYNC:
             return
-        result = value["priority"] if isinstance(value, dict) else dict(value)["priority"]
         for fn in self._subs:
-            await fn(duty.slot, result)
+            await fn(duty.slot, value)
 
 
 class InfoSync:
@@ -122,6 +126,15 @@ class InfoSync:
         if not slot.last_in_epoch:
             return
         await self.trigger(slot.slot)
+
+    def local_msg(self, slot: int) -> PriorityMsg:
+        """This node's priority message for a slot — served to peers that
+        request our preferences during their exchange fan-out
+        (reference: prioritiser.go request/response handler :350-387)."""
+        return local_priority_msg(self._prio._peer_idx, slot, {
+            self.TOPIC_VERSION: self._versions,
+            self.TOPIC_PROTOCOL: self._protocols,
+        })
 
     async def trigger(self, slot: int) -> None:
         await self._prio.prioritise(slot, {

@@ -108,6 +108,20 @@ class Scheduler:
         for t in self._tasks:
             t.cancel()
 
+    def trim(self, duty: Duty) -> None:
+        """Deadliner GC: drop the duty's definitions + waiters and prune
+        finished fire-tasks and stale epochs (fixes the round-1 finding
+        that `_defs`/`_tasks` grew without bound; reference scheduler GC:
+        core/scheduler/scheduler.go trimDuties)."""
+        self._defs.pop(duty, None)
+        for fut in self._def_waiters.pop(duty, []):
+            if not fut.done():
+                fut.set_result({})
+        self._tasks = [t for t in self._tasks if not t.done()]
+        if len(self._resolved_epochs) > 4:
+            keep = sorted(self._resolved_epochs)[-4:]
+            self._resolved_epochs = set(keep)
+
     # -- resolution ---------------------------------------------------------
 
     async def _resolve_epoch_if_needed(self, tick: SlotTick) -> None:

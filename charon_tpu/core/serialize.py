@@ -17,7 +17,7 @@ from enum import IntEnum
 from typing import Any
 
 from ..eth2util import spec
-from . import qbft, types
+from . import priority, qbft, types
 
 # Registry of wire-visible dataclasses.
 _CLASSES: dict[str, type] = {}
@@ -46,6 +46,7 @@ _register(
     spec.SyncCommitteeContribution, spec.ContributionAndProof,
     spec.SignedContributionAndProof, spec.BeaconCommitteeSelection,
     spec.SyncCommitteeSelection,
+    priority.PriorityMsg, priority.TopicResult,
     qbft.Msg,
 )
 

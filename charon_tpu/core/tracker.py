@@ -14,7 +14,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from enum import IntEnum
 
-from .types import Duty, ParSignedDataSet, PubKey
+from .types import Duty, DutyType, ParSignedDataSet, PubKey
 
 
 class Step(IntEnum):
@@ -31,6 +31,28 @@ class Step(IntEnum):
     SIG_AGG = 8
     AGG_SIG_DB = 9
     BCAST = 10
+
+
+# VC-initiated duties never pass scheduler/fetcher/consensus/dutydb; the
+# first expected step is the validator API (fixes the round-1 finding that
+# they were always misblamed on the fetcher; reference: tracker.go:275-340
+# tracks per-duty expected steps).
+_VC_INITIATED = {DutyType.RANDAO, DutyType.EXIT,
+                 DutyType.BUILDER_REGISTRATION, DutyType.PREPARE_AGGREGATOR,
+                 DutyType.PREPARE_SYNC_CONTRIBUTION, DutyType.SYNC_MESSAGE}
+
+# Internal-only duties end at the AggSigDB — nothing is broadcast.
+_NO_BCAST = {DutyType.RANDAO, DutyType.PREPARE_AGGREGATOR,
+             DutyType.PREPARE_SYNC_CONTRIBUTION}
+
+
+def expected_steps(duty_type: DutyType) -> list[Step]:
+    steps = list(Step)
+    if duty_type in _VC_INITIATED:
+        steps = [s for s in steps if s > Step.DUTY_DB]
+    if duty_type in _NO_BCAST:
+        steps = [s for s in steps if s != Step.BCAST]
+    return steps
 
 
 _REASONS: dict[Step, str] = {
@@ -132,12 +154,14 @@ class Tracker:
             if took_part:
                 self.participation_counts[idx] += 1
 
-        if Step.BCAST in steps:
+        expected = expected_steps(duty.type)
+        final = expected[-1]
+        if final in steps:
             report = DutyReport(duty=duty, success=True,
                                 participation=participation)
         else:
-            failed = Step.SCHEDULER
-            for step in Step:
+            failed = expected[0]
+            for step in expected:
                 if step not in steps:
                     failed = step
                     break

@@ -112,21 +112,25 @@ def _partial_carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
     return x
 
 
+def _shift_up_by(x: jnp.ndarray, d: int) -> jnp.ndarray:
+    pad = [(0, 0)] * (x.ndim - 1) + [(d, 0)]
+    return jnp.pad(x[..., :-d], pad)
+
+
 def _ks_carry(v: jnp.ndarray) -> jnp.ndarray:
     """Exact final carry for limbs in [0, 2^12] (i.e. ≤ 4096, so carries are
-    single bits): Kogge-Stone generate/propagate via associative_scan.
+    single bits): manual Kogge-Stone over generate/propagate planes —
+    log₂(L) rounds of static shifts, no scan machinery (compiles fast).
     Output limbs canonical; overflow of the top limb is dropped (value mod
     2^(12·W) — pad beforehand if the carry-out matters)."""
-    g = v > MASK            # generates (v == 4096; g and p are disjoint)
-    p = v == MASK           # propagates
-
-    def op(x, y):
-        gx, px = x
-        gy, py = y
-        return gy | (py & gx), px & py
-
-    gs, _ = lax.associative_scan(op, (g, p), axis=-1)
-    c_in = _shift_up(gs.astype(DTYPE))
+    g = (v > MASK).astype(DTYPE)    # generates (v == 4096; disjoint from p)
+    p = (v == MASK).astype(DTYPE)   # propagates
+    d = 1
+    while d < v.shape[-1]:
+        g = g | (p & _shift_up_by(g, d))
+        p = p & _shift_up_by(p, d)
+        d *= 2
+    c_in = _shift_up(g)             # carry INTO limb k = cumulative g at k−1
     return (v + c_in) & MASK
 
 

@@ -25,6 +25,7 @@ from . import identity as ident
 
 PARSIGEX_PROTOCOL = "/charon_tpu/parsigex/1.0.0"
 CONSENSUS_PROTOCOL = "/charon_tpu/consensus/qbft/1.0.0"
+PRIORITY_PROTOCOL = "/charon_tpu/priority/1.0.0"
 
 
 def sign_consensus_msg(msg: Msg, node_identity: ident.NodeIdentity) -> Msg:
@@ -76,6 +77,44 @@ class P2PParSigEx:
         for fn in self._subs:
             await fn(duty, pset)
         return None
+
+
+class P2PPriorityExchange:
+    """Priority-protocol request/response fan-out over the mesh
+    (reference: core/priority/prioritiser.go:350-387): `exchange(msg)`
+    sends our PriorityMsg to every peer with send_receive; each peer
+    replies with ITS OWN message for that slot (computed by the registered
+    `local_msg(slot)` callback).  Returns all collected messages including
+    our own — the Prioritiser scores them deterministically."""
+
+    def __init__(self, mesh, timeout: float = 3.0):
+        self._mesh = mesh
+        self._local_fn = None
+        self._timeout = timeout
+        mesh.register_handler(PRIORITY_PROTOCOL, self._on_request)
+
+    def register_local(self, fn) -> None:
+        """fn(slot) -> PriorityMsg for this node."""
+        self._local_fn = fn
+
+    async def _on_request(self, sender: int, payload: bytes) -> bytes:
+        req = serialize.decode(payload)
+        if self._local_fn is None:
+            return serialize.encode(None)
+        return serialize.encode(self._local_fn(req.slot))
+
+    async def exchange(self, msg) -> list:
+        async def ask(peer: int):
+            try:
+                reply = await self._mesh.send_receive(
+                    peer, PRIORITY_PROTOCOL, serialize.encode(msg),
+                    timeout=self._timeout)
+                return serialize.decode(reply)
+            except (asyncio.TimeoutError, OSError, ConnectionError):
+                return None
+
+        replies = await asyncio.gather(*(ask(p) for p in self._mesh.peers))
+        return [msg] + [r for r in replies if r is not None]
 
 
 class P2PConsensusTransport:
