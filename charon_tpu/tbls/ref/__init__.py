@@ -6,4 +6,9 @@ from .curve import (  # noqa: F401
     is_on_curve, in_g1, in_g2, clear_cofactor_g1, clear_cofactor_g2,
     g1_to_bytes, g1_from_bytes, g2_to_bytes, g2_from_bytes,
 )
-from .pairing import pairing, miller_loop, final_exponentiate, multi_pairing_is_one  # noqa: F401
+from .pairing import miller_loop, final_exponentiate, multi_pairing_is_one  # noqa: F401
+from .pairing import pairing as pairing_fn  # noqa: F401
+# NOTE: the `pairing` FUNCTION is exported as `pairing_fn` so the package
+# attribute `pairing` keeps referring to the SUBMODULE — re-exporting it
+# under its own name made `from ...ref import pairing` silently return the
+# function and broke module-style imports (round-3 fix).

@@ -12,6 +12,8 @@ from charon_tpu.ops.curve import FP_OPS, F2_OPS
 from charon_tpu.tbls.ref import curve as refcurve
 from charon_tpu.tbls.ref.fields import FQ, FQ2, P
 
+pytestmark = pytest.mark.slow  # heavy XLA compiles; excluded from the fast default lane
+
 
 def _rand_g1(rng, n):
     return [refcurve.multiply(refcurve.G1_GEN, int(rng.integers(1, 1 << 62)))

@@ -13,6 +13,8 @@ from charon_tpu.ops.curve import FP_OPS, F2_OPS
 from charon_tpu.tbls.ref import curve as ref
 from charon_tpu.tbls.ref.fields import R
 
+pytestmark = pytest.mark.slow  # heavy XLA compiles; excluded from the fast default lane
+
 rng = random.Random(0x5EED)
 
 N = 6

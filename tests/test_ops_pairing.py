@@ -7,6 +7,8 @@ gcd(3, r) = 1 this is compared as jax == oracle³.
 
 import random
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,8 +17,10 @@ from charon_tpu.ops import curve as jcurve
 from charon_tpu.ops import pairing as jpair
 from charon_tpu.ops import tower
 from charon_tpu.tbls.ref import curve as ref
-from charon_tpu.tbls.ref import pairing as refpair
+import charon_tpu.tbls.ref.pairing as refpair
 from charon_tpu.tbls.ref.fields import P, R
+
+pytestmark = pytest.mark.slow  # heavy XLA compiles; excluded from the fast default lane
 
 rng = random.Random(0xE77E)
 

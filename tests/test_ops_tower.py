@@ -11,6 +11,8 @@ import pytest
 from charon_tpu.ops import fp, tower
 from charon_tpu.tbls.ref.fields import FQ2, FQ12, P
 
+pytestmark = pytest.mark.slow  # heavy XLA compiles; excluded from the fast default lane
+
 rng = random.Random(0xBA11AD)
 
 

@@ -13,7 +13,9 @@ from charon_tpu.tbls.ref import curve as c
 from charon_tpu.tbls.ref.fields import FQ12, R
 from charon_tpu.tbls.ref.pairing import (final_exponentiate, miller_loop,
                                          multi_pairing_is_one, pairing,
-                                         untwist, cast_g1)
+                                         untwist, cast_g1)  # noqa: F401 (module-direct import avoids the package shadow)
+
+pytestmark = pytest.mark.slow  # pure-python pairings, minutes of CPU
 
 rng = random.Random(0xE1117)
 

@@ -13,6 +13,8 @@ from charon_tpu.tbls import shamir
 from charon_tpu.tbls.ref import bls, curve as refcurve
 from charon_tpu.tbls.ref.hash_to_curve import hash_to_g2
 
+pytestmark = pytest.mark.slow  # heavy XLA compiles; excluded from the fast default lane
+
 
 @pytest.fixture(autouse=True)
 def _bls_tpu_backend():
