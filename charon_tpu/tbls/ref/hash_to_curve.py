@@ -1,17 +1,19 @@
 """Hash-to-G2 per the RFC 9380 random-oracle construction.
 
 Pipeline: expand_message_xmd(SHA-256) → hash_to_field(Fp2, count=2) →
-map_to_curve (Shallue–van de Woestijne) ×2 → point add → clear cofactor.
+map_to_curve ×2 → point add → clear cofactor.
 
-The reference delegates this to kryptology's eth2 ciphersuite
-(reference: tbls/tss.go:28-36).  Zero-egress note: the official eth2 suite
-uses the SSWU map through a 3-isogeny whose published constants cannot be
-validated here without external vectors, so this build uses the SVDW map
-(RFC 9380 §6.6.1) whose constants are *derived in code* from the curve
-equation and are fully self-checkable (outputs must satisfy the curve
-equation; the construction is a proper indifferentiable hash-to-curve
-either way).  The DST is labelled accordingly.  Swapping in SSWU+isogeny
-is a drop-in once vectors can be checked.
+The DEFAULT suite is the eth2 ciphersuite the reference uses
+(BLS12381G2_XMD:SHA-256_SSWU_RO_ with the POP DST — kryptology
+`NewSigEth2`, reference: tbls/tss.go:28-36): SSWU onto the 3-isogenous
+curve E' then the isogeny to E (see sswu.py, incl. the offline structural
+validation of every constant and the h_eff cofactor clearing; round-1
+verdict item 7 replaced the interim SVDW default).
+
+The SVDW map (constants DERIVED in code from the curve equation, fully
+self-contained) is retained as `map_to_curve_svdw` / `hash_to_g2_svdw` —
+a second, independent hash-to-curve used by tests as a cross-check that
+both constructions land in G2 and agree on the RFC pipeline plumbing.
 """
 
 from __future__ import annotations
@@ -21,8 +23,9 @@ import hashlib
 from .curve import Point, add, clear_cofactor_g2, B2, is_on_curve
 from .fields import FQ2, P
 
-DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_POP_"
-DST_POP_G2 = b"BLS_POP_BLS12381G2_XMD:SHA-256_SVDW_RO_POP_"
+DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+DST_POP_G2 = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+DST_G2_SVDW = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SVDW_RO_POP_"
 
 _L = 64          # bytes per field-element coordinate (ceil((381 + 128)/8))
 _H_OUT = 32      # sha256 output
@@ -150,11 +153,22 @@ def map_to_curve_svdw(u: FQ2) -> Point:
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> Point:
-    """Full random-oracle hash to the G2 subgroup."""
+    """Full random-oracle hash to the G2 subgroup — eth2 SSWU suite."""
+    from . import sswu
+
     u0, u1 = hash_to_field_fp2(msg, 2, dst)
-    q0 = map_to_curve_svdw(u0)
-    q1 = map_to_curve_svdw(u1)
+    q0 = sswu.map_to_g2(u0)
+    q1 = sswu.map_to_g2(u1)
     r = add(q0, q1)
+    p = sswu.clear_cofactor_h_eff(r)
+    assert p is None or is_on_curve(p, B2)
+    return p
+
+
+def hash_to_g2_svdw(msg: bytes, dst: bytes = DST_G2_SVDW) -> Point:
+    """SVDW-map variant (independent cross-check construction)."""
+    u0, u1 = hash_to_field_fp2(msg, 2, dst)
+    r = add(map_to_curve_svdw(u0), map_to_curve_svdw(u1))
     p = clear_cofactor_g2(r)
     assert p is None or is_on_curve(p, B2)
     return p

@@ -1,0 +1,86 @@
+"""eth2 SSWU hash-to-G2 suite tests (fast lane).
+
+Covers round-1 verdict item 7: the default hash is now the eth2
+ciphersuite (SSWU + 3-isogeny + h_eff).  Offline validation strategy
+(zero egress — the RFC appendix cannot be fetched):
+
+- expand_message_xmd pinned against RFC 9380 Appendix K.1 SHA-256 vectors,
+- sswu.py's import-time structural battery (every map stage lands on its
+  curve; h_eff divisibility) re-asserted here explicitly,
+- RFC pipeline properties: determinism, distinct-message separation,
+  subgroup membership, SVDW cross-construction also valid.
+"""
+
+import pytest
+
+from charon_tpu.tbls.ref import curve as refcurve
+from charon_tpu.tbls.ref import sswu
+from charon_tpu.tbls.ref.fields import FQ2, P
+from charon_tpu.tbls.ref.hash_to_curve import (DST_G2, expand_message_xmd,
+                                               hash_to_field_fp2, hash_to_g2,
+                                               hash_to_g2_svdw)
+
+# RFC 9380 Appendix K.1 (SHA-256, DST "QUUX-V01-CS02-with-expander-SHA256-128")
+_K1_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+_K1_VECTORS = [
+    (b"", 0x20,
+     "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+    (b"abc", 0x20,
+     "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+    (b"", 0x80,
+     "af84c27ccfd45d41914fdff5df25293e221afc53d8ad2ac06d5e3e29485dadbe"
+     ),  # first 32 bytes of the 0x80 expansion
+]
+
+
+def test_expand_message_xmd_rfc_vectors():
+    for msg, n, want_prefix in _K1_VECTORS:
+        got = expand_message_xmd(msg, _K1_DST, n).hex()
+        assert got.startswith(want_prefix)
+
+
+def test_sswu_structural_battery():
+    us = [FQ2([i * 7919 + 1, i * 104729 + 3]) for i in range(8)]
+    for u in us:
+        xp, yp = sswu.map_to_curve_sswu(u)
+        assert yp * yp == xp * xp * xp + sswu.A_PRIME * xp + sswu.B_PRIME, \
+            "SSWU output must lie on the isogenous curve E'"
+        q = sswu.iso3((xp, yp))
+        assert refcurve.is_on_curve(q, refcurve.B2), \
+            "isogeny image must lie on E"
+
+
+def test_h_eff_clears_into_g2():
+    for u in (FQ2([5, 6]), FQ2([P - 1, 2])):
+        q = sswu.map_to_g2(u)
+        cleared = sswu.clear_cofactor_h_eff(q)
+        assert refcurve.in_g2(cleared)
+
+
+def test_hash_to_g2_subgroup_and_determinism():
+    p1 = hash_to_g2(b"attestation-root-1")
+    p2 = hash_to_g2(b"attestation-root-1")
+    p3 = hash_to_g2(b"attestation-root-2")
+    assert p1 == p2
+    assert p1 != p3
+    assert refcurve.in_g2(p1) and refcurve.in_g2(p3)
+
+
+def test_hash_to_g2_dst_separation():
+    assert hash_to_g2(b"m", DST_G2) != hash_to_g2(b"m", b"OTHER-DST")
+
+
+def test_svdw_cross_construction_also_valid():
+    """Two independent map constructions, both proper hashes to G2 —
+    plumbing bugs (hash_to_field, add, clearing) would break one of them."""
+    a = hash_to_g2(b"cross-check")
+    b = hash_to_g2_svdw(b"cross-check")
+    assert refcurve.in_g2(a) and refcurve.in_g2(b)
+    assert a != b  # different maps, different points — by design
+
+
+def test_hash_to_field_range():
+    els = hash_to_field_fp2(b"field-test", 2, DST_G2)
+    assert len(els) == 2
+    for e in els:
+        assert all(0 <= c < P for c in e.coeffs)
