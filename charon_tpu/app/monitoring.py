@@ -73,10 +73,11 @@ class MonitoringAPI:
 
     def __init__(self, registry: Registry,
                  readyz: Callable[[], tuple[bool, str]],
-                 identity: str = ""):
+                 identity: str = "", qbft_debug: Callable[[], bytes] = None):
         self.registry = registry
         self._readyz = readyz
         self._identity = identity
+        self._qbft_debug = qbft_debug  # app.qbftdebug ring renderer
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
 
@@ -119,4 +120,7 @@ class MonitoringAPI:
                 "503 Service Unavailable", reason.encode())
         if path == "/enr":
             return "200 OK", self._identity.encode()
+        if path == "/debug/qbft" and self._qbft_debug is not None:
+            # reference: app/qbftdebug.go:35-122 sniffed-instance dump
+            return "200 OK", self._qbft_debug()
         return "404 Not Found", b"not found"

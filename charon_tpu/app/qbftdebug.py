@@ -1,0 +1,83 @@
+"""QBFT debug sniffer — ring buffer of consensus instances served over the
+monitoring API.
+
+Mirrors reference core/consensus sniffer + app/qbftdebug.go:35-122: every
+QBFT upon-rule firing (message received, rule classified, round) is
+recorded per duty instance into a bounded ring; `/debug/qbft` renders the
+ring as JSON for post-mortem analysis of stuck/slow consensus rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import OrderedDict, deque
+from dataclasses import asdict, dataclass, field
+
+from ..core.types import Duty
+
+
+@dataclass
+class SniffedMsg:
+    at: float
+    process: int
+    round: int
+    rule: str
+    msg_type: str | None
+    source: int | None
+
+
+@dataclass
+class SniffedInstance:
+    duty: str
+    started: float
+    msgs: list = field(default_factory=list)
+    decided: bool = False
+
+
+class QBFTSniffer:
+    """Bounded per-instance message recorder (ring over instances)."""
+
+    def __init__(self, max_instances: int = 128, max_msgs: int = 512):
+        self._instances: "OrderedDict[str, SniffedInstance]" = OrderedDict()
+        self._max_instances = max_instances
+        self._max_msgs = max_msgs
+
+    def on_rule(self, duty: Duty):
+        """Returns a qbft.Definition.on_rule hook bound to this duty."""
+        key = str(duty)
+
+        def hook(instance, process, round_, msg, rule) -> None:
+            inst = self._instances.get(key)
+            if inst is None:
+                inst = SniffedInstance(duty=key, started=time.time())
+                self._instances[key] = inst
+                while len(self._instances) > self._max_instances:
+                    self._instances.popitem(last=False)
+            if len(inst.msgs) >= self._max_msgs:
+                return
+            rule_name = getattr(rule, "name", str(rule))
+            inst.msgs.append(SniffedMsg(
+                at=time.time(), process=process, round=round_,
+                rule=rule_name,
+                msg_type=(getattr(msg.type, "name", str(msg.type))
+                          if msg is not None else None),
+                source=(msg.source if msg is not None else None)))
+            # decision fires on quorum commits or a relayed decided msg
+            # (core/qbft.py Algorithm 2:8)
+            if rule_name in ("QUORUM_COMMITS", "JUSTIFIED_DECIDED"):
+                inst.decided = True
+
+        return hook
+
+    def render_json(self) -> bytes:
+        out = []
+        for inst in self._instances.values():
+            out.append({
+                "duty": inst.duty,
+                "started": inst.started,
+                "decided": inst.decided,
+                "n_msgs": len(inst.msgs),
+                "msgs": [asdict(m) for m in inst.msgs],
+            })
+        return json.dumps({"instances": out}, indent=1).encode()

@@ -47,9 +47,11 @@ from ..tbls import api as tbls
 from . import featureset
 from .lifecycle import Manager, StartOrder, StopOrder
 from .monitoring import MonitoringAPI, Registry
+from .qbftdebug import QBFTSniffer
 from .peerinfo import PeerInfo
 from .retry import Retryer, with_async_retry
 from .router import VapiRouter
+from .tracing import Tracer, with_tracing
 
 VERSION = "charon-tpu/0.3.0"
 SUPPORTED_PROTOCOLS = ["/charon_tpu/consensus/qbft/1.0.0",
@@ -159,8 +161,10 @@ class App:
         sched = Scheduler(self.eth2cl, list(pubshares),
                           builder_api=cfg.builder_api)
         fetcher = Fetcher(self.eth2cl)
+        self.qbft_sniffer = QBFTSniffer()
         consensus = QBFTConsensus(P2PConsensusTransport(self.mesh),
-                                  self_index, n)
+                                  self_index, n,
+                                  sniffer=self.qbft_sniffer)
         dutydb = MemDutyDB()
         vapi = ValidatorAPI(share_idx=share_idx,
                             pubshare_by_group=pubshares,
@@ -181,8 +185,10 @@ class App:
         self.deadliner = Deadliner(deadline_fn)
         self.retryer = Retryer(deadline_fn)
 
+        self.tracer_spans = Tracer(self.registry)
         interfaces.wire(sched, fetcher, consensus, dutydb, vapi, parsigdb,
                         parsigex, sigagg, aggsigdb, bcast,
+                        with_tracing(self.tracer_spans),
                         with_async_retry(self.retryer))
         sigagg.subscribe(recaster.store)
         sched.subscribe_slots(recaster.slot_ticked)
@@ -227,8 +233,9 @@ class App:
         # 11. peerinfo + monitoring
         self.peerinfo = PeerInfo(self.mesh, VERSION, cluster_hash,
                                  interval=cfg.peerinfo_interval)
-        self.monitoring = MonitoringAPI(self.registry, self._readyz,
-                                        identity=identity.enr())
+        self.monitoring = MonitoringAPI(
+            self.registry, self._readyz, identity=identity.enr(),
+            qbft_debug=self.qbft_sniffer.render_json)
 
         # 12. validator-API HTTP router (reverse proxy → first beacon URL)
         self._index_to_pubkey: dict[int, PubKey] = {}

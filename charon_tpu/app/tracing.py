@@ -1,0 +1,133 @@
+"""Duty tracing — deterministic cross-cluster trace IDs + span-wrapped
+wire edges.
+
+Mirrors reference core/tracing.go:34-142 + app/tracer/trace.go:40-151:
+every duty derives a DETERMINISTIC 128-bit trace ID from (slot, type), so
+when all n nodes export their spans, one cross-cluster trace joins them
+without any coordination.  Every core wire edge is wrapped in a span via
+the `with_tracing` wire option (composable with with_async_retry, like the
+reference's WithTracing).
+
+Spans are collected in-memory (exporters are pluggable sinks); the
+monitoring registry gets per-edge latency histograms for free.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+from ..core.types import Duty
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "charon_tpu_span", default=None)
+
+
+def duty_trace_id(duty: Duty) -> str:
+    """Deterministic 128-bit trace ID shared by all nodes for a duty
+    (reference: core/tracing.go:34-51 fnv128(duty))."""
+    h = hashlib.sha256(f"duty/{duty.slot}/{int(duty.type)}".encode())
+    return h.hexdigest()[:32]
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    name: str
+    parent_id: str | None
+    start: float
+    end: float | None = None
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+
+class Tracer:
+    """In-memory span collector with pluggable export sinks."""
+
+    def __init__(self, registry=None, max_spans: int = 16384):
+        self.spans: list[Span] = []
+        self._registry = registry
+        self._max = max_spans
+        self._seq = 0
+        self._sinks: list = []
+
+    def add_sink(self, fn) -> None:
+        """fn(span) called at span end (exporter hook)."""
+        self._sinks.append(fn)
+
+    def start_span(self, name: str, trace_id: str | None = None,
+                   **attrs) -> "SpanHandle":
+        parent: Span | None = _current_span.get()
+        if trace_id is None:
+            trace_id = (parent.trace_id if parent is not None
+                        else hashlib.sha256(
+                            f"root{self._seq}".encode()).hexdigest()[:32])
+        self._seq += 1
+        span = Span(trace_id=trace_id,
+                    span_id=f"{self._seq:016x}",
+                    name=name,
+                    parent_id=parent.span_id if parent is not None else None,
+                    start=time.time(), attrs=dict(attrs))
+        if len(self.spans) < self._max:
+            self.spans.append(span)
+        return SpanHandle(self, span)
+
+    def _finish(self, span: Span) -> None:
+        span.end = time.time()
+        if self._registry is not None:
+            self._registry.observe("app_span_duration_seconds",
+                                   span.duration, labels={"span": span.name})
+        for fn in self._sinks:
+            fn(span)
+
+    def trace(self, trace_id: str) -> list[Span]:
+        return [s for s in self.spans if s.trace_id == trace_id]
+
+
+class SpanHandle:
+    def __init__(self, tracer: Tracer, span: Span):
+        self._tracer = tracer
+        self.span = span
+        self._token = None
+
+    def __enter__(self) -> Span:
+        self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        _current_span.reset(self._token)
+        if exc is not None:
+            self.span.attrs["error"] = repr(exc)
+        self._tracer._finish(self.span)
+
+
+def with_tracing(tracer: Tracer):
+    """Wire option: span-wrap every duty-carrying core edge
+    (reference: core/tracing.go:64-142 WithTracing wraps each wire edge in
+    a span whose trace ID is the duty's deterministic ID)."""
+
+    _EDGES = ["fetcher_fetch", "consensus_propose", "dutydb_store",
+              "parsigdb_store_internal", "parsigdb_store_external",
+              "parsigex_broadcast", "sigagg_aggregate", "aggsigdb_store",
+              "broadcaster_broadcast"]
+
+    def option(w: dict) -> None:
+        def wrap(name: str, fn):
+            async def traced(duty, *args):
+                with tracer.start_span(f"core/{name}",
+                                       trace_id=duty_trace_id(duty),
+                                       duty=str(duty)):
+                    return await fn(duty, *args)
+
+            return traced
+
+        for edge in _EDGES:
+            w[edge] = wrap(edge, w[edge])
+
+    return option

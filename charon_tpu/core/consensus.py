@@ -56,12 +56,13 @@ class ConsensusMemNetwork:
 class QBFTConsensus:
     def __init__(self, transport: ConsensusMemNetwork, peer_idx: int,
                  nodes: int, round_timeout_base: float = 0.75,
-                 round_timeout_inc: float = 0.25):
+                 round_timeout_inc: float = 0.25, sniffer=None):
         self._net = transport
         self._peer_idx = peer_idx
         self._nodes = nodes
         self._base = round_timeout_base
         self._inc = round_timeout_inc
+        self._sniffer = sniffer  # app.qbftdebug.QBFTSniffer (optional)
         self._subs: list = []
         self._prio_subs: list = []
         self._queues: dict[Duty, asyncio.Queue] = {}
@@ -107,6 +108,8 @@ class QBFTConsensus:
             round_timeout=lambda rnd: self._base + self._inc * rnd,
             nodes=self._nodes,
             decide=decide,
+            on_rule=(self._sniffer.on_rule(duty)
+                     if self._sniffer is not None else None),
         )
 
     def _ensure_instance(self, duty: Duty, input_value: Any) -> None:

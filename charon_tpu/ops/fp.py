@@ -112,25 +112,28 @@ def _partial_carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
     return x
 
 
-def _shift_up_by(x: jnp.ndarray, d: int) -> jnp.ndarray:
-    pad = [(0, 0)] * (x.ndim - 1) + [(d, 0)]
-    return jnp.pad(x[..., :-d], pad)
-
-
 def _ks_carry(v: jnp.ndarray) -> jnp.ndarray:
     """Exact final carry for limbs in [0, 2^12] (i.e. ≤ 4096, so carries are
-    single bits): manual Kogge-Stone over generate/propagate planes —
-    log₂(L) rounds of static shifts, no scan machinery (compiles fast).
+    single bits).  Carry-lookahead via anchor-gather: the carry into limb k
+    is the generate bit of the most recent NON-propagating limb below k
+    (all limbs in between propagate by construction) — one cummax + one
+    gather instead of a log-depth generate/propagate ladder, keeping the
+    emitted HLO tiny (this carry sits inside every field op; compile time
+    of the unrolled pairing graphs is bounded by its op count).
     Output limbs canonical; overflow of the top limb is dropped (value mod
     2^(12·W) — pad beforehand if the carry-out matters)."""
     g = (v > MASK).astype(DTYPE)    # generates (v == 4096; disjoint from p)
-    p = (v == MASK).astype(DTYPE)   # propagates
-    d = 1
-    while d < v.shape[-1]:
-        g = g | (p & _shift_up_by(g, d))
-        p = p & _shift_up_by(p, d)
-        d *= 2
-    c_in = _shift_up(g)             # carry INTO limb k = cumulative g at k−1
+    p = v == MASK                   # propagates (v == 4095)
+    L = v.shape[-1]
+    pos = jnp.arange(L, dtype=DTYPE)
+    # anchor[k] = largest j ≤ k with p[j] False (−1 if none)
+    anchor = lax.cummax(jnp.where(p, -1, pos), axis=v.ndim - 1)
+    pad = [(0, 0)] * (anchor.ndim - 1) + [(1, 0)]
+    anchor_prev = jnp.pad(anchor[..., :-1], pad, constant_values=-1)
+    c_in = jnp.where(
+        anchor_prev >= 0,
+        jnp.take_along_axis(g, jnp.maximum(anchor_prev, 0), axis=-1),
+        0)
     return (v + c_in) & MASK
 
 

@@ -116,6 +116,15 @@ def test_cli_create_cluster_and_run(tmp_path):
             assert "app_peers" in metrics
             assert "core_bcast_delay_seconds" in metrics
 
+            # --- /debug/qbft sniffer ring has decided instances ---
+            import json as _json
+            qdbg = _json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/qbft", timeout=5
+                ).read()))
+            assert qdbg["instances"], "qbft sniffer recorded nothing"
+            assert any(i["decided"] for i in qdbg["instances"])
+
             # --- tracker analysed duties post-deadline (GC ran) ---
             assert any(r.success for a in apps for r in a.tracker.reports), \
                 "tracker never reported a successful duty"
@@ -129,6 +138,22 @@ def test_cli_create_cluster_and_run(tmp_path):
             # --- priority/infosync agreed on protocol precedence ---
             infosync_ok = any(a.infosync._results for a in apps)
             assert infosync_ok, "infosync never reached agreement"
+
+            # --- cross-cluster duty trace: same deterministic trace ID
+            #     joins spans from MULTIPLE nodes (core/tracing.go:34-51) ---
+            from charon_tpu.app.tracing import duty_trace_id
+
+            ok_duty = next(r.duty for a in apps for r in a.tracker.reports
+                           if r.success)
+            tid = duty_trace_id(ok_duty)
+            nodes_with_trace = sum(
+                1 for a in apps if a.tracer_spans.trace(tid))
+            assert nodes_with_trace >= 2, \
+                "duty trace did not join across nodes"
+            spans = apps[0].tracer_spans.trace(tid)
+            assert any(s.name == "core/broadcaster_broadcast"
+                       for s in (s for a in apps
+                                 for s in a.tracer_spans.trace(tid)))
         finally:
             for app in apps:
                 app.life.stop()
