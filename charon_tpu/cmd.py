@@ -56,6 +56,9 @@ def main(argv: list[str] | None = None) -> int:
     runp.add_argument("--keystore-dir", default=_env("keystore-dir", ""))
     runp.add_argument("--feature-enable", action="append", default=[])
     runp.add_argument("--feature-disable", action="append", default=[])
+    runp.add_argument("--tbls-scheme", default=_env("tbls-scheme", "bls"),
+                      choices=["bls", "insecure-test"],
+                      help="insecure-test is for smoke/compose testing only")
 
     # -- dkg ----------------------------------------------------------------
     dkgp = sub.add_parser("dkg", help="participate in a DKG ceremony")
@@ -82,6 +85,8 @@ def main(argv: list[str] | None = None) -> int:
     cc.add_argument("--fork-version", default="0x00000000")
     cc.add_argument("--cluster-dir", default="./cluster")
     cc.add_argument("--base-port", type=int, default=16000)
+    cc.add_argument("--tbls-scheme", default="bls",
+                    choices=["bls", "insecure-test"])
 
     ce = csub.add_parser("enr", help="create a new identity key + ENR")
     ce.add_argument("--data-dir", default=".charon")
@@ -124,6 +129,10 @@ def main(argv: list[str] | None = None) -> int:
 
 def _cmd_run(args) -> int:
     from .app.run import RunConfig, App
+    from .tbls import api as tbls
+
+    if args.tbls_scheme != "bls":
+        tbls.set_scheme(args.tbls_scheme)
 
     async def main() -> None:
         bmock_server = None
@@ -229,6 +238,8 @@ def _create_cluster(args) -> int:
     from .p2p import identity as ident
     from .tbls import api as tbls
 
+    if args.tbls_scheme != "bls":
+        tbls.set_scheme(args.tbls_scheme)
     n = args.nodes
     threshold = args.threshold or math.ceil(n * 2 / 3)
     fork = bytes.fromhex(args.fork_version[2:])

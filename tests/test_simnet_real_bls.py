@@ -1,0 +1,94 @@
+"""Simnet with REAL BLS end-to-end on the batched device backend.
+
+Round-1 verdict item 6: every e2e test ran the insecure-test scalar scheme,
+so bytes-level bugs at the tbls boundary (compressed-point edge cases,
+backend padding) were unreachable.  This runs the full duty pipeline with
+`set_scheme("bls")` + `set_backend("tpu")`: partial signatures are real
+BLS12-381 signatures over SSWU-hashed roots, verification and threshold
+combination run through the batched JAX kernels (8-virtual-device CPU mesh
+in CI; the same code path serves the real chip).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from charon_tpu.app.node import Node, NodeConfig
+from charon_tpu.core.leadercast import LeaderCast, MemTransportNetwork
+from charon_tpu.core.parsigex import MemParSigExNetwork
+from charon_tpu.eth2util.signing import DomainName, signing_root
+from charon_tpu.tbls import api as tbls
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.cluster import new_cluster_for_test
+from charon_tpu.testutil.validatormock import ValidatorMock
+
+pytestmark = pytest.mark.slow  # real pairings + kernel compiles
+
+N_NODES = 3
+THRESHOLD = 2
+N_VALS = 1
+SLOT_DUR = 2.0       # generous: every partial verify is a real pairing
+SPE = 4
+FORK = bytes.fromhex("00000000")
+
+
+@pytest.fixture(autouse=True)
+def real_bls_tpu_backend():
+    tbls.set_scheme("bls")
+    tbls.set_backend("tpu")
+    yield
+    tbls.set_backend("cpu")
+
+
+def test_simnet_real_bls_attestation_on_device_backend():
+    cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
+    bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)
+    for v in cluster.validators:
+        bmock.add_validator(v.group_pubkey)
+
+    pubshares_by_peer = {
+        idx: cluster.pubshare_map(idx) for idx in range(1, N_NODES + 1)}
+    psx_net = MemParSigExNetwork()
+    lc_net = MemTransportNetwork()
+    nodes = []
+    for idx in range(1, N_NODES + 1):
+        cfg = NodeConfig(share_idx=idx, threshold=THRESHOLD,
+                         pubshares_by_peer=pubshares_by_peer,
+                         fork_version=FORK)
+        node = Node(cfg, bmock,
+                    consensus=LeaderCast(lc_net, idx - 1, N_NODES),
+                    parsigex=psx_net.join(),
+                    slots_per_epoch=SPE, genesis_time=bmock.genesis,
+                    slot_duration=SLOT_DUR)
+        vmock = ValidatorMock(node.vapi, cluster.share_privkey_map(idx),
+                              FORK, slots_per_epoch=SPE)
+        node.scheduler.subscribe_slots(vmock.on_slot)
+        nodes.append(node)
+
+    async def run():
+        for n in nodes:
+            n.start()
+        deadline = time.time() + 6 * SPE * SLOT_DUR + 60.0
+        try:
+            while time.time() < deadline:
+                await asyncio.sleep(0.25)
+                if bmock.attestations:
+                    await asyncio.sleep(SLOT_DUR)
+                    break
+        finally:
+            for n in nodes:
+                n.stop()
+            await asyncio.sleep(0)
+
+    asyncio.run(run())
+
+    assert bmock.attestations, "no attestations with real BLS on the backend"
+    assert tbls.scheme_name() == "bls" and tbls.backend_name() == "tpu"
+    for att in bmock.attestations:
+        root = signing_root(DomainName.BEACON_ATTESTER,
+                            att.data.hash_tree_root(), FORK)
+        assert len(att.signature) == 96
+        ok = any(tbls.verify(v.tss.group_pubkey, root, att.signature)
+                 for v in cluster.validators)
+        assert ok, "real-BLS group signature failed pairing verification"

@@ -1,0 +1,113 @@
+"""Multi-PROCESS smoke test — the compose equivalent.
+
+Mirrors reference testutil/compose/smoke (smoke_test.go:43-137): real
+`python -m charon_tpu run` subprocesses (separate interpreters, real TCP
+mesh between them, real HTTP to a shared beacon mock in the test process),
+booted from `create cluster` artifacts on disk.  Asserts threshold-signed
+duties arrive at the BN and that the cluster survives one node down
+(t-of-n degradation, the 1-of-4-down scenario).
+"""
+
+import asyncio
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from charon_tpu.cmd import main as cli_main
+from charon_tpu.core.types import pubkey_from_bytes
+from charon_tpu.eth2util.signing import DomainName, signing_root
+from charon_tpu.tbls import api as tbls
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.beaconmock_http import BeaconMockServer
+
+N, T, M = 3, 2, 1
+SLOT_DUR = 1.0
+SPE = 8
+FORK = bytes.fromhex("00000000")
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def test_smoke_subprocess_cluster(tmp_path):
+    cluster_dir = str(tmp_path / "cluster")
+    base_port = random.randint(23000, 48000)
+    assert cli_main(["create", "cluster", "--nodes", str(N),
+                     "--threshold", str(T), "--num-validators", str(M),
+                     "--cluster-dir", cluster_dir,
+                     "--base-port", str(base_port),
+                     "--tbls-scheme", "insecure-test"]) == 0
+
+    from charon_tpu.cluster.definition import load_json, lock_from_json
+
+    lock = lock_from_json(
+        load_json(os.path.join(cluster_dir, "node0", "cluster-lock.json")))
+
+    async def main():
+        bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)
+        for v in lock.validators:
+            bmock.add_validator(pubkey_from_bytes(v.public_key))
+        server = BeaconMockServer(bmock)
+        await server.start()
+
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   CHARON_TPU_TBLS_SCHEME="insecure-test")
+        procs = []
+        # n-1 nodes only: one node down from the start — threshold still met
+        # (reference smoke partial-failure scenario)
+        for i in range(N - 1):
+            node_dir = os.path.join(cluster_dir, f"node{i}")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "charon_tpu", "run",
+                 "--lock-file", os.path.join(node_dir, "cluster-lock.json"),
+                 "--identity-key-file",
+                 os.path.join(node_dir, "charon-enr-private-key"),
+                 "--beacon-node-endpoints", server.addr,
+                 "--validator-api-address", "127.0.0.1:0",
+                 "--monitoring-address", "127.0.0.1:0",
+                 "--simnet-validator-mock",
+                 "--tbls-scheme", "insecure-test"],
+                env=env, cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                await asyncio.sleep(0.5)
+                for p in procs:
+                    assert p.poll() is None, (
+                        "node process died:\n"
+                        + p.stdout.read().decode(errors="replace")[-2000:])
+                if bmock.attestations:
+                    await asyncio.sleep(2 * SLOT_DUR)
+                    break
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            await server.stop()
+
+        assert bmock.attestations, \
+            "no attestations from the subprocess cluster"
+        for att in bmock.attestations:
+            root = signing_root(DomainName.BEACON_ATTESTER,
+                                att.data.hash_tree_root(), FORK)
+            assert any(tbls.verify(v.public_key, root, att.signature)
+                       for v in lock.validators), "bad group signature"
+
+    asyncio.run(main())
