@@ -1,0 +1,41 @@
+"""Golden-file test helper (reference: testutil/golden.go:39-100).
+
+`require_golden_json(name, obj)` compares `obj` against
+tests/testdata/<name>.json; set CHARON_TPU_UPDATE_GOLDEN=1 to (re)generate
+— the equivalent of the reference's `-update` flag.  Snapshots pin wire
+formats (cluster files, beacon-API JSON, the core wire codec) so silent
+format drift fails loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_TESTDATA = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tests", "testdata")
+
+
+def _update_enabled() -> bool:
+    return os.environ.get("CHARON_TPU_UPDATE_GOLDEN") == "1"
+
+
+def require_golden_json(name: str, obj) -> None:
+    """Assert obj equals the committed snapshot tests/testdata/<name>.json."""
+    path = os.path.join(_TESTDATA, name + ".json")
+    rendered = json.dumps(obj, indent=2, sort_keys=True)
+    if _update_enabled() or not os.path.exists(path):
+        os.makedirs(_TESTDATA, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(rendered + "\n")
+        if _update_enabled():
+            return
+        raise AssertionError(
+            f"golden file {name}.json did not exist — generated it; "
+            "commit it and re-run")
+    with open(path) as f:
+        want = f.read().rstrip("\n")
+    assert rendered == want, (
+        f"golden mismatch for {name}.json — run with "
+        f"CHARON_TPU_UPDATE_GOLDEN=1 to regenerate if intentional\n"
+        f"got:\n{rendered[:2000]}\nwant:\n{want[:2000]}")
