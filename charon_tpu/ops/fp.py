@@ -122,7 +122,7 @@ def _ks_carry(v: jnp.ndarray) -> jnp.ndarray:
     of the unrolled pairing graphs is bounded by its op count).
     Output limbs canonical; overflow of the top limb is dropped (value mod
     2^(12·W) — pad beforehand if the carry-out matters)."""
-    g = (v > MASK).astype(DTYPE)    # generates (v == 4096; disjoint from p)
+    g = v > MASK                    # generates (v == 4096; disjoint from p)
     p = v == MASK                   # propagates (v == 4095)
     L = v.shape[-1]
     pos = jnp.arange(L, dtype=DTYPE)
@@ -130,10 +130,12 @@ def _ks_carry(v: jnp.ndarray) -> jnp.ndarray:
     anchor = lax.cummax(jnp.where(p, -1, pos), axis=v.ndim - 1)
     pad = [(0, 0)] * (anchor.ndim - 1) + [(1, 0)]
     anchor_prev = jnp.pad(anchor[..., :-1], pad, constant_values=-1)
-    c_in = jnp.where(
-        anchor_prev >= 0,
-        jnp.take_along_axis(g, jnp.maximum(anchor_prev, 0), axis=-1),
-        0)
+    # c_in[k] = g[anchor_prev[k]] — realised as a one-hot comparison matrix
+    # reduction, NOT a gather: take_along_axis lowers to a scalarised
+    # gather on this TPU target and was ~1000x slower than the arithmetic
+    # around it.  [.., L, L] bool ops stay on the vector unit.
+    eq = anchor_prev[..., :, None] == pos
+    c_in = jnp.any(eq & g[..., None, :], axis=-1).astype(DTYPE)
     return (v + c_in) & MASK
 
 
