@@ -69,33 +69,46 @@ def _combine_kernel(pts, bits):
     return jcurve.msm(F2_OPS, pts, bits, axis=1)
 
 
+# The combine path runs as THREE launches, not one fused program: the
+# experimental axon TPU target kernel-faults on very large fused programs
+# (decompress+subgroup+MSM+normalise in one jit crashed the worker at
+# V·T ≥ 8192 — the round-2 bench failure), and the intermediate
+# materialisation between launches is negligible next to the MSM.
+
 @jax.jit
-def _combine_bytes_kernel(xc0, xc1, sign, inf, bits):
-    """Fused bytes-path combine: decompress [V, T] G2 x-coordinates (batched
-    Fp2 sqrt), Lagrange-MSM along T, normalise back to std-form affine limbs.
-    One launch per padded (V, T) tier."""
-    pts, ok = codec.g2_decompress(xc0, xc1, sign, inf)
+def _decompress_kernel(xc0, xc1, sign, inf):
+    return codec.g2_decompress(xc0, xc1, sign, inf)
+
+
+@jax.jit
+def _msm_normalize_kernel(pts, bits):
     combined = jcurve.msm(F2_OPS, pts, bits, axis=1)
-    oxc0, oxc1, oyc0, oyc1, oinf = codec.g2_normalize(combined)
-    return oxc0, oxc1, oyc0, oyc1, oinf, ok
+    return codec.g2_normalize(combined)
 
 
 @jax.jit
-def _verify_bytes_kernel(pk_x, pk_sign, pk_inf, sg_xc0, sg_xc1, sg_sign,
-                         sg_inf, hm_pts):
-    """Fused bytes-path verify: decompress pubkeys (G1) + signatures (G2),
-    then one pairing-product check e(−g1, sig)·e(pk, H(m)) == 1 per row."""
+def _verify_decompress_kernel(pk_x, pk_sign, pk_inf, sg_xc0, sg_xc1,
+                              sg_sign, sg_inf):
+    """Bytes-path verify, launch 1: decompress pubkeys (G1) + sigs (G2).
+    Separate from the pairing launch for the same axon fused-program-size
+    reason as the combine path."""
     pks, ok1 = codec.g1_decompress(pk_x, pk_sign, pk_inf)
     sigs, ok2 = codec.g2_decompress(sg_xc0, sg_xc1, sg_sign, sg_inf)
-    neg_g1 = jnp.broadcast_to(jnp.asarray(_NEG_G1), pks.shape)
-    ps = jnp.stack([neg_g1, pks], axis=1)       # [V, 2, 3, 32]
-    qs = jnp.stack([sigs, hm_pts], axis=1)      # [V, 2, 3, 2, 32]
-    ok = jpair.pairing_product_is_one(ps, qs, pair_axis=1)
     # reject the identity pubkey / identity signature (eth2 POP scheme
     # rejects infinity keys; also keeps padding rows from reading as valid
     # real entries — padding validity is handled host-side by slicing)
     nontrivial = ~codec_is_inf_g1(pks) & ~codec_is_inf_g2(sigs)
-    return ok & ok1 & ok2 & nontrivial
+    return pks, sigs, ok1 & ok2 & nontrivial
+
+
+@jax.jit
+def _verify_pairing_kernel(pks, sigs, hm_pts):
+    """Launch 2: one pairing-product check e(−g1, sig)·e(pk, H(m)) == 1
+    per row."""
+    neg_g1 = jnp.broadcast_to(jnp.asarray(_NEG_G1), pks.shape)
+    ps = jnp.stack([neg_g1, pks], axis=1)       # [V, 2, 3, 32]
+    qs = jnp.stack([sigs, hm_pts], axis=1)      # [V, 2, 3, 2, 32]
+    return jpair.pairing_product_is_one(ps, qs, pair_axis=1)
 
 
 def codec_is_inf_g1(pts):
@@ -189,10 +202,11 @@ class TPUBackend:
         if bad[: len(batch) * t].any():
             raise ValueError("malformed compressed G2 signature in batch")
         shape = (v, t, jcurve.fp.NLIMBS)
-        oxc0, oxc1, oyc0, oyc1, oinf, ok = _combine_bytes_kernel(
+        pts, ok = _decompress_kernel(
             jnp.asarray(xc0.reshape(shape)), jnp.asarray(xc1.reshape(shape)),
-            jnp.asarray(sign.reshape(v, t)), jnp.asarray(inf.reshape(v, t)),
-            jnp.asarray(bits))
+            jnp.asarray(sign.reshape(v, t)), jnp.asarray(inf.reshape(v, t)))
+        oxc0, oxc1, oyc0, oyc1, oinf = _msm_normalize_kernel(
+            pts, jnp.asarray(bits))
         if not np.asarray(ok)[: len(batch)].all():
             raise ValueError("signature bytes not on the G2 curve")
         out = codec.g2_compress_np(np.asarray(oxc0), np.asarray(oxc1),
@@ -234,9 +248,11 @@ class TPUBackend:
             hms[k] = self._hash_point(msg)
         pk_x, pk_sign, pk_inf, pk_bad = codec.g1_bytes_split(pk_raw)
         sg_xc0, sg_xc1, sg_sign, sg_inf, sg_bad = codec.g2_bytes_split(sg_raw)
-        ok = _verify_bytes_kernel(
+        pks, sigs, dec_ok = _verify_decompress_kernel(
             jnp.asarray(pk_x), jnp.asarray(pk_sign), jnp.asarray(pk_inf),
             jnp.asarray(sg_xc0), jnp.asarray(sg_xc1), jnp.asarray(sg_sign),
-            jnp.asarray(sg_inf), jnp.asarray(hms))
-        ok = np.asarray(ok) & ~pk_bad & ~sg_bad & length_ok
+            jnp.asarray(sg_inf))
+        ok = _verify_pairing_kernel(pks, sigs, jnp.asarray(hms))
+        ok = (np.asarray(ok) & np.asarray(dec_ok)
+              & ~pk_bad & ~sg_bad & length_ok)
         return [bool(b) for b in ok[:n]]

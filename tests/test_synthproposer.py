@@ -1,0 +1,72 @@
+"""SynthProposer wrapper tests (reference: app/eth2wrap/synthproposer.go)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.eth2util.synthproposer import SynthProposerClient
+from charon_tpu.eth2util import spec
+from charon_tpu.tbls import api as tbls
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.cluster import new_cluster_for_test
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def test_synth_proposer_duties_and_block_swallowing():
+    async def main():
+        cluster = new_cluster_for_test(2, 3, 2)
+        bmock = BeaconMock(slot_duration=1.0, slots_per_epoch=8)
+        for v in cluster.validators:
+            bmock.add_validator(v.group_pubkey)
+        # mainnet-realistic sparsity: the cluster proposes only at slot 0
+        # (the mock otherwise assigns a proposer every slot, leaving no
+        # room for synthesis — real networks have ~0 proposals per epoch
+        # for a small cluster, which is what synthproposer exists for)
+        from charon_tpu.testutil.beaconmock import ProposerDutyInfo
+
+        first = next(iter(bmock.validators.values()))
+
+        async def sparse(epoch, indices):
+            return [ProposerDutyInfo(pubkey=first.pubkey,
+                                     validator_index=first.index,
+                                     slot=epoch * 8)]
+
+        bmock.overrides["proposer_duties"] = sparse
+        cl = SynthProposerClient(bmock)
+        cl.register_pubkeys([v.group_pubkey for v in cluster.validators])
+
+        indices = [v.index for v in bmock.validators.values()]
+        duties = await cl.proposer_duties(0, indices)
+        # every slot of the epoch now has a proposer duty
+        assert {d.slot for d in duties} == set(range(8))
+        real = await bmock.proposer_duties(0, indices)
+        synth_slots = set(range(8)) - {d.slot for d in real}
+        assert synth_slots, "expected at least one synthetic slot"
+
+        # synthetic slots serve deterministic synthetic blocks...
+        s = sorted(synth_slots)[0]
+        blk1 = await cl.beacon_block_proposal(s, b"\x01" * 96)
+        blk2 = await cl.beacon_block_proposal(s, b"\x02" * 96)
+        assert blk1.body == b"synthetic" and blk1.slot == s
+        assert blk1.state_root == blk2.state_root  # deterministic
+
+        # ...and submissions of synthetic blocks never reach the BN
+        await cl.submit_beacon_block(
+            spec.SignedBeaconBlock(message=blk1, signature=b"\x03" * 96))
+        assert not bmock.blocks
+        assert len(cl.synthetic_blocks_submitted) == 1
+
+        # real-slot proposals still pass through
+        r = sorted(d.slot for d in real)[0]
+        rb = await cl.beacon_block_proposal(r, b"\x01" * 96)
+        await cl.submit_beacon_block(
+            spec.SignedBeaconBlock(message=rb, signature=b"\x04" * 96))
+        assert len(bmock.blocks) == 1
+
+    asyncio.run(main())
