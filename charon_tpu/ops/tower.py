@@ -117,11 +117,14 @@ def f2_inv(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def f2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(a == 0, axis=(-1, -2))
+    """Value-semantics zero test: redundant residues are zero iff each
+    coefficient is ≡ 0 mod p (raw limb comparison is wrong in the plain
+    redundant representation — x−x reduces to a multiple of p)."""
+    return fp.is_zero(a[..., 0, :]) & fp.is_zero(a[..., 1, :])
 
 
 def f2_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(a == b, axis=(-1, -2))
+    return f2_is_zero(f2_sub(a, b))
 
 
 def f2_select(cond, a, b):
@@ -392,7 +395,11 @@ def f12_select(cond, a, b):
 
 
 def f12_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+    """Value-semantics equality: every Fp coefficient of a−b ≡ 0 mod p
+    (12 stacked zero tests through one fp.is_zero launch)."""
+    d = f12_sub(a, b)
+    flat = d.reshape(*d.shape[:-4], 12, d.shape[-1])
+    return jnp.all(fp.is_zero(flat), axis=-1)
 
 
 # ---------------------------------------------------------------------------

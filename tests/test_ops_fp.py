@@ -110,3 +110,60 @@ def test_vmap_consistency(ab):
     direct = fp.mul(aj, bj)
     vmapped = jax.vmap(fp.mul)(aj, bj)
     assert (np.asarray(direct) == np.asarray(vmapped)).all()
+
+
+def test_redundant_chain_adversarial():
+    """The plain-redundant representation (limbs ≤ 8191, exact carries only
+    at boundaries) must stay exact through deep op chains — including the
+    worst reachable limb patterns.  Chains mix mul/add/sub/neg/double and
+    compare canon_std output against Python bigints each round."""
+    a_int = [(1 << 381) - 1, P - 1, 1, rng.randrange(P), rng.randrange(P)]
+    b_int = [P - 2, (P + 1) // 2, rng.randrange(P), 2, rng.randrange(P)]
+    aj = jnp.asarray(fp.pack(a_int))
+    bj = jnp.asarray(fp.pack(b_int))
+
+    @jax.jit
+    def chain(x, y):
+        for _ in range(4):
+            m = fp.mul(x, y)
+            s = fp.add(m, x)
+            d = fp.sub(s, y)
+            n = fp.neg(d)
+            x, y = fp.mul_small(n, 13), fp.double(m)
+        return fp.canon_std(x), fp.canon_std(y), x
+
+    gx, gy, raw = chain(aj, bj)
+    # mirror in bigints
+    xi, yi = list(a_int), list(b_int)
+    for _ in range(4):
+        mi = [(x * y) % P for x, y in zip(xi, yi)]
+        si = [(m + x) % P for m, x in zip(mi, xi)]
+        di = [(s - y) % P for s, y in zip(si, yi)]
+        ni = [(-d) % P for d in di]
+        xi = [(n * 13) % P for n in ni]
+        yi = [(2 * m) % P for m in mi]
+    assert fp.unpack(gx) == xi
+    assert fp.unpack(gy) == yi
+    # representation invariant: limbs bounded by LMAX after every op
+    assert int(jnp.max(raw)) <= fp.LMAX
+
+
+def test_eq_is_zero_mod_p_semantics():
+    """x − x must test zero/equal even though its limbs are a nonzero
+    multiple of p in the redundant representation."""
+    vals = [0, 1, P - 1, rng.randrange(P)]
+    aj = jnp.asarray(fp.pack(vals))
+    d = fp.sub(aj, aj)
+    assert bool(jnp.all(fp.is_zero(d)))
+    assert not bool(jnp.any(fp.is_zero(fp.add(d, jnp.asarray(fp.pack([1] * 4))))))
+    assert bool(jnp.all(fp.eq(fp.add(aj, d), aj)))
+
+
+def test_canon_std_idempotent_and_bounded():
+    vals = [0, 1, P - 1, (1 << 381) - 1] + [rng.randrange(P) for _ in range(8)]
+    aj = jnp.asarray(fp.pack(vals))
+    big = fp.mul_small(fp.add(fp.mul(aj, aj), aj), 16)   # deep redundant
+    std = fp.canon_std(big)
+    assert fp.unpack(std) == [((v * v + v) * 16) % P for v in vals]
+    assert int(jnp.max(std)) <= fp.MASK                  # canonical limbs
+    assert np.array_equal(np.asarray(fp.canon_std(std)), np.asarray(std))

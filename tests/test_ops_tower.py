@@ -112,11 +112,13 @@ def test_f12_mul_by_014(packed):
 
 
 def test_f6_inv_roundtrip():
-    """No oracle Fp6; check a·a⁻¹ = 1 and v·ξ-consistency through f12."""
+    """No oracle Fp6; check a·a⁻¹ = 1 (value semantics — redundant limbs
+    are compared through the mod-p equality, not raw)."""
     a6 = jnp.asarray(tower.f12_pack([rand_fq12()]))[:, 0]  # random Fp6
     prod = tower.f6_mul(a6, jax.jit(tower.f6_inv)(a6))
     one = jnp.broadcast_to(jnp.asarray(tower.F6_ONE_M), prod.shape)
-    assert (np.asarray(prod) == np.asarray(one)).all()
+    for k in range(3):
+        assert bool(tower.f2_eq(prod[..., k, :, :], one[..., k, :, :]).all())
 
 
 def test_f6_mul_by_v_matches_w_squared():
@@ -126,6 +128,6 @@ def test_f6_mul_by_v_matches_w_squared():
     w2 = FQ12([0, 0, 1] + [0] * 9)
     got0 = tower.f6_mul_by_v(a12[:, 0])
     got1 = tower.f6_mul_by_v(a12[:, 1])
-    want = tower.f12_pack([a * w2])
     got = np.stack([np.asarray(got0[0]), np.asarray(got1[0])])
-    assert (got == want[0]).all()
+    # value-semantics comparison (redundant limbs): unpack applies mod p
+    assert tower.f12_unpack(got[None]) == [a * w2]
