@@ -231,8 +231,8 @@ _R_BITS = np.array([(R >> (254 - i)) & 1 for i in range(255)], np.int32)
 
 
 def g2_psi(pt: jnp.ndarray) -> jnp.ndarray:
-    """ψ on Jacobian coords: (c_x·X̄ᵖ, c_y·Ȳᵖ, Z̄ᵖ) — Frobenius commutes with
-    the Jacobian scaling since the constants absorb the weight factors."""
+    """ψ on projective coords: (c_x·X̄ : c_y·Ȳ : Z̄) — the affine
+    endomorphism constants apply directly to homogeneous coordinates."""
     x, y, z = jcurve._coords(F2_OPS, pt)
     return jcurve.make_point(
         F2_OPS,

@@ -1,14 +1,25 @@
-"""Batched G1/G2 Jacobian point arithmetic for BLS12-381 on TPU.
+"""Batched G1/G2 point arithmetic for BLS12-381 on TPU.
 
 Replaces the reference's kryptology curve layer (reference: tbls/tss.go:21-23)
 with branch-free, batched JAX ops: one code path serves G1 (coords in Fp,
 [..., 32]) and G2 (coords in Fp2, [..., 2, 32]) via a small field-ops table.
 
-Points are Jacobian (X, Y, Z) in Montgomery form, stacked on axis −(ndim+1);
-infinity is encoded Z = 0 and every op is total: exceptional cases
-(P = ±Q, P = ∞) are resolved with `select`, never Python branches, so the
-whole group law jits to straight-line XLA and vectorises over the validator
-batch (the `*Set` axis of the reference, docs/architecture.md:126-128).
+Points are HOMOGENEOUS PROJECTIVE (X : Y : Z), stacked on axis −(ndim+1);
+infinity is (0 : 1 : 0).  The group law is the Renes–Costello–Batina
+COMPLETE addition/doubling for a = 0 curves (EUROCRYPT 2016, Algs. 7/9):
+one formula valid for every input pair — doubling, inverses, infinity —
+with NO zero-tests.  That choice is load-bearing twice over: (a) no Python
+branches, so everything jits straight-line and vectorises over the
+validator batch (the `*Set` axis of the reference,
+docs/architecture.md:126-128); (b) no field equality checks inside the
+scalar-mul loop — in the redundant-limb representation equality needs an
+exact carry, which the earlier Jacobian law paid 4× per bit and which
+dominated MSM device time.  b₃ = 3b is 12 (G1) / 12(1+u) (G2): a
+small-constant multiple, not a full field multiply.
+
+Completeness caveat honoured by callers: the formulas are complete on
+odd-order subgroups; all pipeline inputs are (or are checked to be) in the
+prime-order G1/G2 subgroups.
 
 Correctness oracle: charon_tpu.tbls.ref.curve (affine, arbitrary precision).
 """
@@ -49,8 +60,17 @@ class FieldOps:
     select: Callable
     mul_many: Callable   # batched independent products — one multiplier call
     sqr_many: Callable
-    one_m: Any   # Montgomery 1 constant (numpy)
-    b_m: Any     # curve coefficient b in Montgomery form (numpy)
+    mul_b3: Callable     # ×3b (small-constant multiple; RCB formulas)
+    one_m: Any   # internal-form 1 constant (numpy)
+    b_m: Any     # curve coefficient b (numpy)
+
+
+def _fp_mul_b3(x):
+    return fp.mul_small(x, 12)          # 3·b = 12 on G1
+
+
+def _f2_mul_b3(x):
+    return tower.f2_mul_small(tower.f2_mul_by_xi(x), 12)  # 3·4(1+u) = 12ξ
 
 
 FP_OPS = FieldOps(
@@ -59,6 +79,7 @@ FP_OPS = FieldOps(
     dbl=fp.double, mul_small=fp.mul_small, inv=fp.inv,
     is_zero=fp.is_zero, eq=fp.eq, select=fp.select,
     mul_many=fp.mul_many, sqr_many=fp.sqr_many,
+    mul_b3=_fp_mul_b3,
     one_m=fp.ONE_M,
     b_m=fp.to_limbs(4 * fp.R_MONT % P),
 )
@@ -70,6 +91,7 @@ F2_OPS = FieldOps(
     inv=tower.f2_inv, is_zero=tower.f2_is_zero, eq=tower.f2_eq,
     select=tower.f2_select,
     mul_many=tower.f2_mul_many, sqr_many=tower.f2_sqr_many,
+    mul_b3=_f2_mul_b3,
     one_m=tower.F2_ONE_M,
     b_m=tower.f2_pack([FQ2([4, 4])])[0],  # twist: y² = x³ + 4(u+1)
 )
@@ -96,10 +118,10 @@ def point_select(F: FieldOps, cond, a, b):
 
 
 def inf_point(F: FieldOps, batch_shape=()):
-    """Infinity: (1, 1, 0) in Montgomery form."""
+    """Infinity: the projective point (0 : 1 : 0)."""
     one = jnp.asarray(np.asarray(F.one_m))
     zero = jnp.zeros_like(one)
-    pt = jnp.stack([one, one, zero])
+    pt = jnp.stack([zero, one, zero])
     return jnp.broadcast_to(pt, batch_shape + pt.shape)
 
 
@@ -109,10 +131,15 @@ def is_inf(F: FieldOps, pt):
 
 
 def from_affine(F: FieldOps, x, y, inf=None):
+    """(x, y) → (x : y : 1); rows flagged `inf` become exactly (0 : 1 : 0)
+    — the complete formulas require genuine curve points, so the garbage
+    affine coords of infinity rows must be replaced, not just Z-zeroed."""
     one = jnp.broadcast_to(jnp.asarray(np.asarray(F.one_m)), x.shape)
     z = one
     if inf is not None:
         z = F.select(inf, jnp.zeros_like(one), one)
+        x = F.select(inf, jnp.zeros_like(one), x)
+        y = F.select(inf, one, y)
     return make_point(F, x, y, z)
 
 
@@ -122,78 +149,77 @@ def neg_point(F: FieldOps, pt):
 
 
 def double_point(F: FieldOps, pt):
-    """dbl-2009-l (a = 0).  Z=0 (infinity) maps to Z3 = 0 automatically.
-    Independent products grouped into 4 batched multiplier calls."""
-    x1, y1, z1 = _coords(F, pt)
-    a, b = F.sqr_many([x1, y1])
-    c, s2 = F.sqr_many([b, F.add(x1, b)])
-    d = F.dbl(F.sub(F.sub(s2, a), c))
-    e = F.mul_small(a, 3)
-    f, yz = F.mul_many([(e, e), (y1, z1)])
-    x3 = F.sub(f, F.dbl(d))
-    [m] = F.mul_many([(e, F.sub(d, x3))])
-    y3 = F.sub(m, F.mul_small(c, 8))
-    z3 = F.dbl(yz)
+    """COMPLETE doubling, RCB16 Algorithm 9 (a = 0): valid for every input
+    including infinity; no zero-tests.  8 field products in 2 batched
+    multiplier calls."""
+    x, y, z = _coords(F, pt)
+    yy, yz, zz, xy = F.mul_many([(y, y), (y, z), (z, z), (x, y)])
+    bzz = F.mul_b3(zz)                       # 3b·Z²
+    e8 = F.mul_small(yy, 8)                  # 8Y²
+    s = F.add(yy, bzz)                       # Y² + 3bZ²
+    d = F.sub(yy, F.mul_small(bzz, 3))       # Y² − 9bZ²
+    x3a, z3, y3a, x3b = F.mul_many(
+        [(bzz, e8), (yz, e8), (d, s), (d, xy)])
+    y3 = F.add(x3a, y3a)
+    x3 = F.dbl(x3b)
     return make_point(F, x3, y3, z3)
 
 
 def add_points(F: FieldOps, p1, p2):
-    """Complete addition: add-2007-bl with select-resolved exceptional cases
-    (P=Q → doubling; P=−Q → ∞ falls out of the formula; P or Q = ∞).
-    Independent products grouped into 6 batched multiplier calls."""
+    """COMPLETE addition, RCB16 Algorithm 7 (a = 0): one straight-line
+    formula for every input pair — P = Q, P = −Q, either = ∞ — with NO
+    equality/zero checks (each would cost an exact carry in the redundant
+    limb representation).  12 field products in 2 batched calls."""
     x1, y1, z1 = _coords(F, p1)
     x2, y2, z2 = _coords(F, p2)
-    z1z1, z2z2 = F.sqr_many([z1, z2])
-    u1, u2, y1z2, y2z1 = F.mul_many(
-        [(x1, z2z2), (x2, z1z1), (y1, z2), (y2, z1)])
-    s1, s2 = F.mul_many([(y1z2, z2z2), (y2z1, z1z1)])
-    h = F.sub(u2, u1)
-    r = F.dbl(F.sub(s2, s1))
-    i, r2, zz = F.sqr_many([F.dbl(h), r, F.add(z1, z2)])
-    j, v = F.mul_many([(h, i), (u1, i)])
-    x3 = F.sub(F.sub(r2, j), F.dbl(v))
-    t1, t2, z3 = F.mul_many(
-        [(r, F.sub(v, x3)), (s1, j), (F.sub(F.sub(zz, z1z1), z2z2), h)])
-    y3 = F.sub(t1, F.dbl(t2))
-    raw = make_point(F, x3, y3, z3)
-
-    same = F.is_zero(h) & F.is_zero(r)  # P == Q (in the group sense)
-    out = point_select(F, same, double_point(F, p1), raw)
-    out = point_select(F, is_inf(F, p1), p2, out)
-    out = point_select(F, is_inf(F, p2), p1, out)
-    return out
+    t0, t1, t2, pxy, pyz, pxz = F.mul_many([
+        (x1, x2), (y1, y2), (z1, z2),
+        (F.add(x1, y1), F.add(x2, y2)),
+        (F.add(y1, z1), F.add(y2, z2)),
+        (F.add(x1, z1), F.add(x2, z2))])
+    t3 = F.sub(pxy, F.add(t0, t1))           # X1Y2 + X2Y1
+    t4 = F.sub(pyz, F.add(t1, t2))           # Y1Z2 + Y2Z1
+    t5 = F.sub(pxz, F.add(t0, t2))           # X1Z2 + X2Z1
+    m = F.mul_small(t0, 3)                   # 3·X1X2
+    bz = F.mul_b3(t2)                        # 3b·Z1Z2
+    s = F.add(t1, bz)                        # Y1Y2 + 3bZ1Z2
+    d = F.sub(t1, bz)                        # Y1Y2 − 3bZ1Z2
+    by = F.mul_b3(t5)                        # 3b·(X1Z2+X2Z1)
+    x3a, x3b, y3a, y3b, z3a, z3b = F.mul_many([
+        (t3, d), (t4, by), (d, s), (m, by), (t4, s), (t3, m)])
+    return make_point(F, F.sub(x3a, x3b), F.add(y3a, y3b),
+                      F.add(z3a, z3b))
 
 
 def to_affine(F: FieldOps, pt):
-    """Jacobian → affine (x, y, is_inf).  Infinity maps to (0, 0, True)
-    because inv(0) = 0 in the fp layer."""
+    """Projective → affine (x, y, is_inf).  Infinity maps to (0, 0, True)
+    because inv(z≡0) ≡ 0 in the fp layer."""
     x, y, z = _coords(F, pt)
     zinv = F.inv(z)
-    zinv2 = F.sqr(zinv)
-    return (F.mul(x, zinv2), F.mul(y, F.mul(zinv, zinv2)), F.is_zero(z))
+    return (F.mul(x, zinv), F.mul(y, zinv), F.is_zero(z))
 
 
 def eq_points(F: FieldOps, p1, p2):
-    """Group-element equality across different Jacobian representatives."""
+    """Group-element equality across projective representatives:
+    X1Z2 = X2Z1 and Y1Z2 = Y2Z1.  Infinity needs no special case: only
+    (0:1:0) has Z ≡ 0, making both cross-products vanish against any
+    finite point's nonzero Y-ratio test."""
     x1, y1, z1 = _coords(F, p1)
     x2, y2, z2 = _coords(F, p2)
-    z1z1, z2z2 = F.sqr_many([z1, z2])
     xa, xb, ya, yb = F.mul_many(
-        [(x1, z2z2), (x2, z1z1), (y1, z2), (y2, z1)])
-    ya2, yb2 = F.mul_many([(ya, z2z2), (yb, z1z1)])
-    ex = F.eq(xa, xb)
-    ey = F.eq(ya2, yb2)
+        [(x1, z2), (x2, z1), (y1, z2), (y2, z1)])
     i1, i2 = F.is_zero(z1), F.is_zero(z2)
-    return (i1 & i2) | (~i1 & ~i2 & ex & ey)
+    return (i1 & i2) | (~i1 & ~i2 & F.eq(xa, xb) & F.eq(ya, yb))
 
 
 def on_curve(F: FieldOps, pt):
-    """Y² = X³ + b·Z⁶ (vacuously true at ∞)."""
+    """Y²Z = X³ + b·Z³ (vacuously true at ∞)."""
     x, y, z = _coords(F, pt)
-    z3 = F.mul(z, F.sqr(z))
-    rhs = F.add(F.mul(F.sqr(x), x),
-                F.mul(jnp.asarray(np.asarray(F.b_m)), F.sqr(z3)))
-    return F.eq(F.sqr(y), rhs) | F.is_zero(z)
+    zz, yy = F.sqr_many([z, y])
+    lhs, x2, z3b = F.mul_many([
+        (yy, z), (x, x), (F.mul(jnp.asarray(np.asarray(F.b_m)), zz), z)])
+    rhs = F.add(F.mul(x2, x), z3b)
+    return F.eq(lhs, rhs) | F.is_zero(z)
 
 
 # ---------------------------------------------------------------------------
@@ -254,11 +280,11 @@ def msm(F: FieldOps, pts, bits, axis: int = 0):
 # ---------------------------------------------------------------------------
 
 def g1_pack(pts) -> np.ndarray:
-    """Host: list of oracle G1 affine points (or None) → [len, 3, 32]."""
+    """Host: list of oracle G1 affine points (or None → (0:1:0)) →
+    [len, 3, 32]."""
     out = np.zeros((len(pts), 3, fp.NLIMBS), np.int32)
     for n, pt in enumerate(pts):
         if pt is None:
-            out[n, 0] = fp.ONE_M
             out[n, 1] = fp.ONE_M
         else:
             out[n, 0] = fp.to_limbs(pt[0].n * fp.R_MONT % P)
@@ -268,11 +294,11 @@ def g1_pack(pts) -> np.ndarray:
 
 
 def g2_pack(pts) -> np.ndarray:
-    """Host: list of oracle G2 affine points (or None) → [len, 3, 2, 32]."""
+    """Host: list of oracle G2 affine points (or None → (0:1:0)) →
+    [len, 3, 2, 32]."""
     out = np.zeros((len(pts), 3, 2, fp.NLIMBS), np.int32)
     for n, pt in enumerate(pts):
         if pt is None:
-            out[n, 0] = tower.F2_ONE_M
             out[n, 1] = tower.F2_ONE_M
         else:
             out[n, 0] = tower.f2_pack([pt[0]])[0]

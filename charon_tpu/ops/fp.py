@@ -144,7 +144,7 @@ def _fold_high(x: jnp.ndarray) -> jnp.ndarray:
     return x[..., :NLIMBS] + jnp.sum(hi[..., :, None] * fold, axis=-2)
 
 
-def _reduce(x: jnp.ndarray, iters: int = 7) -> jnp.ndarray:
+def _reduce(x: jnp.ndarray, iters: int = 5) -> jnp.ndarray:
     """Any nonnegative column vector [*, W] (32 ≤ W ≤ 66, columns < 2^31)
     → redundant residue with limbs ≤ LMAX.
 
@@ -152,24 +152,24 @@ def _reduce(x: jnp.ndarray, iters: int = 7) -> jnp.ndarray:
     replaces the ≥2^384 digits c·2^(12k) by c·(2^(12k) mod p); since
     2^384 mod p = 2^384 − 9p < 0.087·2^384, the value satisfies
         V' ≤ 1.0003·2^384 + 0.087·V.
-    From the worst conv output (V ≈ 2^770 → after the wide fold ≤ 2^398.1)
-    seven rounds give V < 2·2^384, at which point the ≥2^384 digit is ≤ 1
-    and the final fold leaves limbs ≤ 4096 + 4095 = LMAX.  Overflow safety
-    inside a round: digits of any nonnegative decomposition obey
-    dₖ ≤ V/2^(12k), so fold products are ≤ (V/2^384)·4095 < 2^31 for all
-    reachable V.  Callers with small inputs pass fewer iters:
-    add/sub V < 2^386.3 closes in 1; small scalar muls in 2.
-    (Exactness exercised in tests/test_ops_fp.py with adversarial
-    max-limb inputs through deep op chains.)"""
+    From the worst conv output (V < 2^770 → after the wide fold the value
+    is ≤ 34·4224·p + 1.0003·2^384 < 2^397.9) five rounds give V < 2·2^384,
+    at which point the ≥2^384 digit is ≤ 1 and the final fold leaves limbs
+    ≤ 4096 + 4095 = LMAX.  Overflow safety inside a round: digits of any
+    nonnegative decomposition obey dₖ ≤ V/2^(12k), so fold products are
+    ≤ (V/2^384)·4095 < 2^31 for all reachable V.  Callers with small
+    inputs pass fewer iters: add/sub (V < 2^386.3) close in 1; small
+    scalar muls in 2.  The rounds are UNROLLED: a fori_loop here puts a
+    while-loop inside every field multiply and its per-iteration overhead
+    dominated device time.  (Exactness exercised in tests/test_ops_fp.py
+    with adversarial max-limb inputs through deep op chains.)"""
     pad2 = [(0, 0)] * (x.ndim - 1) + [(0, 2)]
     x = _partial_carry(jnp.pad(x, pad2), 2)
     x = _fold_high(x)
-
-    def body(_, v):
-        v = _partial_carry(jnp.pad(v, pad2), 2)
-        return _fold_high(v)
-
-    return lax.fori_loop(0, iters, body, x)
+    for _ in range(iters):
+        x = _partial_carry(jnp.pad(x, pad2), 2)
+        x = _fold_high(x)
+    return x
 
 
 # ---------------------------------------------------------------------------
