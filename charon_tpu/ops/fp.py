@@ -217,9 +217,35 @@ def _conv(a: jnp.ndarray, b: jnp.ndarray, out_cols: int) -> jnp.ndarray:
     return shifted.sum(axis=-2)[..., :out_cols]
 
 
+_pallas_mul = None  # resolved once; None = undecided, False = disabled
+
+
+def _use_pallas() -> bool:
+    """Route multiplies through the fused Pallas kernel on real TPU
+    backends (ops/pallas_fp.py).  The jnp path stays authoritative for
+    CPU (tests, virtual sharded meshes) and under CHARON_TPU_PALLAS=0."""
+    global _pallas_mul
+    if _pallas_mul is None:
+        import os
+
+        _pallas_mul = False
+        if os.environ.get("CHARON_TPU_PALLAS", "1") == "1":
+            try:
+                if jax.default_backend() == "tpu":
+                    from . import pallas_fp
+
+                    _pallas_mul = pallas_fp.mul
+            except Exception:  # pragma: no cover - no backend at all
+                _pallas_mul = False
+    return _pallas_mul
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a·b mod p: one convolution (63 columns ≤ 32·LMAX² < 2^31) folded
     back to 32 limbs.  No Montgomery domain, no exact carries."""
+    pk = _use_pallas()
+    if pk:
+        return pk(a, b)
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)

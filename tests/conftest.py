@@ -8,9 +8,11 @@ Must run before jax is imported anywhere.
 import os
 
 # Force, don't setdefault: the dev environment pre-sets JAX_PLATFORMS=axon
-# (the tunneled TPU); tests must compile locally on CPU.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+# (the tunneled TPU); tests must compile locally on CPU.  Set
+# CHARON_TPU_TEST_TPU=1 to keep the real device (the tpu-marked suites).
+if os.environ.get("CHARON_TPU_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,7 +23,8 @@ if "xla_force_host_platform_device_count" not in flags:
 # config already snapshotted JAX_PLATFORMS=axon — override it directly.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("CHARON_TPU_TEST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the single-core CPU box pays each heavy
 # kernel compile (pairing/MSM) only once across test runs.
 jax.config.update("jax_compilation_cache_dir",
