@@ -58,7 +58,7 @@ def main() -> None:
 
     V = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     T = int(sys.argv[2]) if len(sys.argv) > 2 else 7      # 7-of-10
-    REPS = int(sys.argv[3]) if len(sys.argv) > 3 else 7
+    REPS = int(sys.argv[3]) if len(sys.argv) > 3 else 3
     rng = np.random.default_rng(20260729)
 
     api.set_scheme("bls")
