@@ -43,6 +43,16 @@ def real_bls_tpu_backend():
 
 def test_simnet_real_bls_attestation_on_device_backend():
     cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
+
+    # Pre-warm the device kernels: the first verify/combine pays minutes of
+    # XLA compile on a cold cache, which would stall the slot schedule and
+    # expire every duty before the pipeline runs.
+    v0 = cluster.validators[0]
+    warm_sig = tbls.sign(v0.share_privkeys[1], b"warm")
+    tbls.verify(v0.pubshares[1], b"warm", warm_sig)
+    tbls.threshold_combine(
+        [{i: tbls.sign(v0.share_privkeys[i], b"warm")
+          for i in (1, 2)}])
     bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)
     for v in cluster.validators:
         bmock.add_validator(v.group_pubkey)
