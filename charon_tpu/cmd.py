@@ -103,6 +103,17 @@ def main(argv: list[str] | None = None) -> int:
     cd.add_argument("--dkg-algorithm", default="default")
     cd.add_argument("--output-file", default="cluster-definition.json")
 
+    # -- combine ------------------------------------------------------------
+    comb = sub.add_parser(
+        "combine",
+        help="recombine threshold key shares into the group secret "
+             "(reference: testutil/combine)")
+    comb.add_argument("--cluster-dir", required=True,
+                      help="dir with node*/validator_keys keystores")
+    comb.add_argument("--output-dir", default="./combined")
+    comb.add_argument("--tbls-scheme", default="bls",
+                      choices=["bls", "insecure-test"])
+
     # -- enr / version ------------------------------------------------------
     enrp = sub.add_parser("enr", help="print this node's ENR record")
     enrp.add_argument("--identity-key-file",
@@ -118,6 +129,7 @@ def main(argv: list[str] | None = None) -> int:
         "run": _cmd_run,
         "dkg": _cmd_dkg,
         "create": _cmd_create,
+        "combine": _cmd_combine,
         "enr": _cmd_enr,
         "version": _cmd_version,
     }[args.cmd](args)
@@ -268,12 +280,21 @@ def _create_cluster(args) -> int:
     # lock signature: per-validator group signature over the lock hash
     unsigned = Lock(definition=definition, validators=validators)
     from .cluster.definition import lock_hash as lh
+    from .eth2util import deposit as deposit_mod
+    from .eth2util.spec import DepositData
 
     msg = lh(unsigned)
-    group_sigs = []
+    group_sigs, deposits = [], []
+    creds = deposit_mod.withdrawal_credentials(b"\x00" * 20)
     for tss, shares in zip(tsses, shares_by_val):
         group_sk = tbls.combine_shares(shares)
         group_sigs.append(tbls.sign(group_sk, msg))
+        droot = deposit_mod.deposit_signing_root(
+            tss.group_pubkey, creds, fork)
+        deposits.append(DepositData(
+            pubkey=tss.group_pubkey, withdrawal_credentials=creds,
+            amount=deposit_mod.DEPOSIT_AMOUNT_GWEI,
+            signature=tbls.sign(group_sk, droot)))
     lock = Lock(definition=definition, validators=validators,
                 signature_aggregate=b"".join(group_sigs))
 
@@ -288,6 +309,8 @@ def _create_cluster(args) -> int:
         keystore.store_keys(
             [shares[i + 1] for shares in shares_by_val],
             os.path.join(node_dir, "validator_keys"))
+        deposit_mod.save_deposit_data(
+            os.path.join(node_dir, "deposit-data.json"), deposits, fork)
     print(f"created {n}-node cluster (threshold {threshold}, "
           f"{args.num_validators} validators) in {args.cluster_dir}")
     print(f"lock hash: 0x{lock.lock_hash.hex()}")
@@ -327,6 +350,57 @@ def _create_dkg(args) -> int:
         dkg_algorithm=args.dkg_algorithm)
     save_json(args.output_file, definition_to_json(definition))
     print(f"wrote {args.output_file}")
+    return 0
+
+
+def _cmd_combine(args) -> int:
+    """Recombine per-node share keystores into group secrets — the escape
+    hatch for leaving a cluster (reference: testutil/combine/main.go).
+    Requires ≥ threshold node directories' keystores."""
+    import glob
+
+    from .cluster.definition import load_json, lock_from_json
+    from .eth2util import keystore
+    from .tbls import api as tbls
+
+    if args.tbls_scheme != "bls":
+        tbls.set_scheme(args.tbls_scheme)
+    node_dirs = sorted(glob.glob(os.path.join(args.cluster_dir, "node*")))
+    if not node_dirs:
+        print("error: no node*/ dirs found", file=sys.stderr)
+        return 1
+    lock = lock_from_json(
+        load_json(os.path.join(node_dirs[0], "cluster-lock.json")))
+    threshold = lock.definition.threshold
+
+    # share_idx (1-based) is the operator index + 1; collect per validator
+    shares_by_val: dict[int, dict[int, bytes]] = {
+        v: {} for v in range(len(lock.validators))}
+    for d in node_dirs:
+        idx = int(os.path.basename(d).removeprefix("node")) + 1
+        ks_dir = os.path.join(d, "validator_keys")
+        if not os.path.isdir(ks_dir):
+            continue
+        for v, sk in enumerate(keystore.load_keys(ks_dir)):
+            shares_by_val[v][idx] = sk
+    os.makedirs(args.output_dir, exist_ok=True)
+    secrets_out = []
+    for v, dv in enumerate(lock.validators):
+        shares = shares_by_val[v]
+        if len(shares) < threshold:
+            print(f"error: validator {v}: {len(shares)} shares < "
+                  f"threshold {threshold}", file=sys.stderr)
+            return 1
+        take = dict(list(shares.items())[:threshold])
+        group_sk = tbls.combine_shares(take)
+        if tbls.privkey_to_pubkey(group_sk) != dv.public_key:
+            print(f"error: validator {v}: recombined secret does not match "
+                  "the lock's group pubkey", file=sys.stderr)
+            return 1
+        secrets_out.append(group_sk)
+    keystore.store_keys(secrets_out, args.output_dir)
+    print(f"recombined {len(secrets_out)} validator secrets "
+          f"into {args.output_dir}")
     return 0
 
 

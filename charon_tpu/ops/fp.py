@@ -176,12 +176,30 @@ def _reduce(x: jnp.ndarray, iters: int = 5) -> jnp.ndarray:
 # Ring ops (redundant residues in, redundant residues out)
 # ---------------------------------------------------------------------------
 
+# Below this many residues the per-op layout transposes cost more than the
+# pallas kernels save; the jnp path keeps small/mid batches.
+PALLAS_MIN_ROWS = 1 << 16
+
+
+def _rows(shape) -> int:
+    n = 1
+    for d in shape[:-1]:
+        n *= d
+    return n
+
+
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    pk = _use_pallas()
+    if pk and _rows(jnp.broadcast_shapes(a.shape, b.shape)) >= PALLAS_MIN_ROWS:
+        return pk.add(a, b)
     return _reduce(a + b, iters=1)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a − b + 48p (the spread form keeps every limb difference ≥ 0)."""
+    pk = _use_pallas()
+    if pk and _rows(jnp.broadcast_shapes(a.shape, b.shape)) >= PALLAS_MIN_ROWS:
+        return pk.sub(a, b)
     t = jnp.asarray(SPREAD48P) + jnp.pad(
         a - b, [(0, 0)] * (a.ndim - 1) + [(0, 1)])
     return _reduce(t, iters=1)
@@ -189,18 +207,24 @@ def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
     """48p − a (per-limb nonnegative thanks to the spread form)."""
+    pk = _use_pallas()
+    if pk and _rows(a.shape) >= PALLAS_MIN_ROWS:
+        return pk.neg(a)
     t = jnp.asarray(SPREAD48P) - jnp.pad(
         a, [(0, 0)] * (a.ndim - 1) + [(0, 1)])
     return _reduce(t, iters=1)
 
 
 def double(a: jnp.ndarray) -> jnp.ndarray:
-    return _reduce(a * 2, iters=1)
+    return mul_small(a, 2)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     """a·k for a small static positive k ≤ 16 (group-law constants)."""
     assert 1 <= k <= 16
+    pk = _use_pallas()
+    if pk and _rows(a.shape) >= PALLAS_MIN_ROWS:
+        return pk.mul_small(a, k)
     return _reduce(a * k, iters=2)
 
 
@@ -217,27 +241,27 @@ def _conv(a: jnp.ndarray, b: jnp.ndarray, out_cols: int) -> jnp.ndarray:
     return shifted.sum(axis=-2)[..., :out_cols]
 
 
-_pallas_mul = None  # resolved once; None = undecided, False = disabled
+_pallas_mod = None  # resolved once; None = undecided, False = disabled
 
 
-def _use_pallas() -> bool:
-    """Route multiplies through the fused Pallas kernel on real TPU
+def _use_pallas():
+    """Route the ring ops through the fused Pallas kernels on real TPU
     backends (ops/pallas_fp.py).  The jnp path stays authoritative for
     CPU (tests, virtual sharded meshes) and under CHARON_TPU_PALLAS=0."""
-    global _pallas_mul
-    if _pallas_mul is None:
+    global _pallas_mod
+    if _pallas_mod is None:
         import os
 
-        _pallas_mul = False
+        _pallas_mod = False
         if os.environ.get("CHARON_TPU_PALLAS", "1") == "1":
             try:
                 if jax.default_backend() == "tpu":
                     from . import pallas_fp
 
-                    _pallas_mul = pallas_fp.mul
+                    _pallas_mod = pallas_fp
             except Exception:  # pragma: no cover - no backend at all
-                _pallas_mul = False
-    return _pallas_mul
+                _pallas_mod = False
+    return _pallas_mod
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -245,7 +269,7 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     back to 32 limbs.  No Montgomery domain, no exact carries."""
     pk = _use_pallas()
     if pk:
-        return pk(a, b)
+        return pk.mul(a, b)
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)

@@ -86,40 +86,109 @@ def _mul_kernel(a_ref, b_ref, o_ref):
         o_ref[i] = cols[i]
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _mul_tiles(a_t: jnp.ndarray, b_t: jnp.ndarray) -> jnp.ndarray:
-    """[32, NB·8, 128] × [32, NB·8, 128] → same shape, reduced product."""
+def _reduce_cols(cols, rounds=2):
+    """In-kernel equivalent of fp._reduce for ≤34-col small-value inputs
+    (add/sub: value < 2^386.3 closes in two pc2+fold rounds)."""
+    for _ in range(rounds):
+        cols = _fold_high(_pc(cols, 2))
+    return cols
+
+
+def _add_kernel(a_ref, b_ref, o_ref):
+    cols = [a_ref[i] + b_ref[i] for i in range(_NL)]
+    cols = _reduce_cols(cols)
+    for i in range(_NL):
+        o_ref[i] = cols[i]
+
+
+def _sub_kernel(a_ref, b_ref, o_ref):
+    cols = [int(fp.SPREAD48P[i]) + a_ref[i] - b_ref[i] for i in range(_NL)]
+    cols.append(jnp.full_like(cols[0], int(fp.SPREAD48P[_NL])))
+    cols = _reduce_cols(cols)
+    for i in range(_NL):
+        o_ref[i] = cols[i]
+
+
+def _neg_kernel(a_ref, o_ref):
+    cols = [int(fp.SPREAD48P[i]) - a_ref[i] for i in range(_NL)]
+    cols.append(jnp.full_like(cols[0], int(fp.SPREAD48P[_NL])))
+    cols = _reduce_cols(cols)
+    for i in range(_NL):
+        o_ref[i] = cols[i]
+
+
+def _small_kernel_factory(k: int):
+    def _kern(a_ref, o_ref):
+        cols = [a_ref[i] * k for i in range(_NL)]
+        cols = _reduce_cols(cols, rounds=3)    # value ≤ 16·2^385 → 3 rounds
+        for i in range(_NL):
+            o_ref[i] = cols[i]
+
+    return _kern
+
+
+def _tiles_call(kernel, n_in: int, a_t, b_t=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     nb = a_t.shape[1] // SUBLANES
     spec = pl.BlockSpec((_NL, SUBLANES, LANES), lambda i: (0, i, 0),
                         memory_space=pltpu.VMEM)
-    return pl.pallas_call(
-        _mul_kernel,
+    call = pl.pallas_call(
+        kernel,
         grid=(nb,),
-        in_specs=[spec, spec],
+        in_specs=[spec] * n_in,
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct(a_t.shape, jnp.int32),
-    )(a_t, b_t)
+    )
+    return call(a_t) if b_t is None else call(a_t, b_t)
+
+
+def _to_tiles(x: jnp.ndarray, n: int, pad: int) -> jnp.ndarray:
+    x2 = x.reshape(n, _NL)
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2.reshape((n + pad) // LANES, LANES, _NL).transpose(2, 0, 1)
+
+
+def _binop(kernel, a: jnp.ndarray, b: jnp.ndarray | None) -> jnp.ndarray:
+    if b is not None:
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        a = jnp.broadcast_to(a, shape)
+        b = jnp.broadcast_to(b, shape)
+    else:
+        shape = a.shape
+    lead = shape[:-1]
+    n = int(np.prod(lead)) if lead else 1
+    pad = (-n) % TILE
+    a_t = _to_tiles(a, n, pad)
+    b_t = _to_tiles(b, n, pad) if b is not None else None
+    out_t = _tiles_call(kernel, 1 if b is None else 2, a_t, b_t)
+    out = out_t.transpose(1, 2, 0).reshape(n + pad, _NL)[:n]
+    return out.reshape(*lead, _NL)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Drop-in for fp.mul on TPU: same redundant-residue contract."""
-    shape = jnp.broadcast_shapes(a.shape, b.shape)
-    a = jnp.broadcast_to(a, shape)
-    b = jnp.broadcast_to(b, shape)
-    lead = shape[:-1]
-    n = int(np.prod(lead)) if lead else 1
-    pad = (-n) % TILE
-    a2 = a.reshape(n, _NL)
-    b2 = b.reshape(n, _NL)
-    if pad:
-        a2 = jnp.pad(a2, ((0, pad), (0, 0)))
-        b2 = jnp.pad(b2, ((0, pad), (0, 0)))
-    m = (n + pad) // LANES
-    a_t = a2.reshape(m, LANES, _NL).transpose(2, 0, 1)
-    b_t = b2.reshape(m, LANES, _NL).transpose(2, 0, 1)
-    out_t = _mul_tiles(a_t, b_t)
-    out = out_t.transpose(1, 2, 0).reshape(n + pad, _NL)[:n]
-    return out.reshape(*lead, _NL)
+    return _binop(_mul_kernel, a, b)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _binop(_add_kernel, a, b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _binop(_sub_kernel, a, b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _binop(_neg_kernel, a, None)
+
+
+@functools.lru_cache(maxsize=32)
+def _small_kernel(k: int):
+    return _small_kernel_factory(k)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    return _binop(_small_kernel(k), a, None)

@@ -48,6 +48,19 @@ def test_cli_create_cluster_and_run(tmp_path):
                                            "charon-enr-private-key"))
         assert os.path.exists(os.path.join(node_dir, "validator_keys",
                                            f"keystore-{M-1}.json"))
+        assert os.path.exists(os.path.join(node_dir, "deposit-data.json"))
+
+    # `combine` recombines t-of-n share keystores into the group secrets
+    # (reference: testutil/combine)
+    combined_dir = str(tmp_path / "combined")
+    rc = cli_main(["combine", "--cluster-dir", cluster_dir,
+                   "--output-dir", combined_dir,
+                   "--tbls-scheme", "insecure-test"])
+    assert rc == 0
+    from charon_tpu.eth2util import keystore as ks_mod
+
+    group_secrets = ks_mod.load_keys(combined_dir)
+    assert len(group_secrets) == M
 
     from charon_tpu.app.run import App, RunConfig
     from charon_tpu.cluster.definition import load_json, lock_from_json

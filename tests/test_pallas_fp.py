@@ -49,3 +49,26 @@ def test_pallas_mul_redundant_inputs():
     red = fp.add(aj, aj)                      # redundant representation
     out = pallas_fp.mul(red, red)
     assert fp.unpack(np.asarray(out)) == [(4 * v * v) % P for v in vals]
+
+
+def test_pallas_ring_ops_match_bigints():
+    from charon_tpu.ops import pallas_fp
+
+    vals_a = [0, 1, P - 1, (1 << 381) - 1] + \
+        [rng.randrange(P) for _ in range(1024)]
+    vals_b = [P - 2, 2, 1, (P + 1) // 2] + \
+        [rng.randrange(P) for _ in range(1024)]
+    aj = jnp.asarray(fp.pack(vals_a))
+    bj = jnp.asarray(fp.pack(vals_b))
+    red_a = pallas_fp.mul(aj, bj)           # redundant inputs downstream
+    assert fp.unpack(np.asarray(pallas_fp.add(red_a, bj))) == \
+        [(x * y + y) % P for x, y in zip(vals_a, vals_b)]
+    assert fp.unpack(np.asarray(pallas_fp.sub(red_a, bj))) == \
+        [(x * y - y) % P for x, y in zip(vals_a, vals_b)]
+    assert fp.unpack(np.asarray(pallas_fp.neg(red_a))) == \
+        [(-x * y) % P for x, y in zip(vals_a, vals_b)]
+    for k in (2, 3, 8, 16):
+        out = pallas_fp.mul_small(red_a, k)
+        assert fp.unpack(np.asarray(out)) == \
+            [(k * x * y) % P for x, y in zip(vals_a, vals_b)]
+        assert int(np.asarray(out).max()) <= fp.LMAX
