@@ -160,13 +160,57 @@ def verify_lock(lock: Lock) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Operator signatures (reference: cluster/eip712sigs.go — the reference
+# signs config/ENR with EIP-712 typed data under the operator's wallet key;
+# here each operator signs with their Ed25519 identity key, the same key
+# pinned in the ENR record, so verification needs no extra key material)
+# ---------------------------------------------------------------------------
+
+_CONFIG_SIG_CTX = b"charon-tpu/config-signature/v1"
+_ENR_SIG_CTX = b"charon-tpu/enr-signature/v1"
+
+
+def sign_operator(d: Definition, op_index: int, identity) -> Definition:
+    """Operator `op_index` signs the definition hash (config terms) and
+    their own ENR with their identity key; returns the updated Definition
+    (reference: cluster/definition.go signing flow)."""
+    op = d.operators[op_index]
+    cfg_sig = identity.sign(_CONFIG_SIG_CTX + definition_hash(d))
+    enr_sig = identity.sign(_ENR_SIG_CTX + op.enr.encode())
+    ops = list(d.operators)
+    ops[op_index] = replace(op, config_signature=cfg_sig,
+                            enr_signature=enr_sig)
+    return replace(d, operators=tuple(ops))
+
+
+def verify_definition_signatures(d: Definition) -> None:
+    """Verify every operator's config + ENR signature against the Ed25519
+    key in their own ENR record (reference: cluster/definition.go:158-248
+    VerifySignatures).  Raises on any missing/invalid signature."""
+    from ..p2p import identity as ident
+
+    h = definition_hash(d)
+    for i, op in enumerate(d.operators):
+        pub, _, _ = ident.enr_parse(op.enr)
+        if not op.config_signature or not op.enr_signature:
+            raise ValueError(f"operator {i}: missing signatures")
+        if not ident.verify(pub, op.config_signature, _CONFIG_SIG_CTX + h):
+            raise ValueError(f"operator {i}: invalid config signature")
+        if not ident.verify(pub, op.enr_signature,
+                            _ENR_SIG_CTX + op.enr.encode()):
+            raise ValueError(f"operator {i}: invalid ENR signature")
+
+
+# ---------------------------------------------------------------------------
 # JSON codecs (on-disk format)
 # ---------------------------------------------------------------------------
 
 def definition_to_json(d: Definition) -> dict:
     return {
         "name": d.name,
-        "operators": [{"address": o.address, "enr": o.enr}
+        "operators": [{"address": o.address, "enr": o.enr,
+                       "config_signature": "0x" + o.config_signature.hex(),
+                       "enr_signature": "0x" + o.enr_signature.hex()}
                       for o in d.operators],
         "threshold": d.threshold,
         "num_validators": d.num_validators,
@@ -181,8 +225,13 @@ def definition_to_json(d: Definition) -> dict:
 def definition_from_json(obj: dict) -> Definition:
     d = Definition(
         name=obj["name"],
-        operators=tuple(Operator(address=o["address"], enr=o.get("enr", ""))
-                        for o in obj["operators"]),
+        operators=tuple(
+            Operator(address=o["address"], enr=o.get("enr", ""),
+                     config_signature=bytes.fromhex(
+                         o.get("config_signature", "0x")[2:]),
+                     enr_signature=bytes.fromhex(
+                         o.get("enr_signature", "0x")[2:]))
+            for o in obj["operators"]),
         threshold=obj["threshold"],
         num_validators=obj["num_validators"],
         fork_version=bytes.fromhex(obj["fork_version"][2:]),

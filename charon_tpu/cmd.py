@@ -70,6 +70,10 @@ def main(argv: list[str] | None = None) -> int:
                                    ".charon/charon-enr-private-key"))
     dkgp.add_argument("--output-dir", default=_env("output-dir", ".charon"))
     dkgp.add_argument("--algorithm", default=_env("algorithm", None))
+    dkgp.add_argument("--no-verify", action="store_true",
+                      default=_env("no-verify") == "true",
+                      help="skip operator signature verification on the "
+                           "definition")
 
     # -- create {cluster,enr,dkg} ------------------------------------------
     createp = sub.add_parser("create", help="create cluster artifacts")
@@ -210,6 +214,11 @@ def _cmd_dkg(args) -> int:
 
     async def main() -> None:
         definition = definition_from_json(load_json(args.definition_file))
+        if not args.no_verify and any(
+                op.config_signature for op in definition.operators):
+            from .cluster.definition import verify_definition_signatures
+
+            verify_definition_signatures(definition)
         with open(args.identity_key_file) as f:
             identity = ident.NodeIdentity.from_bytes(
                 bytes.fromhex(f.read().strip()))
@@ -265,6 +274,12 @@ def _create_cluster(args) -> int:
                             threshold=threshold,
                             num_validators=args.num_validators,
                             fork_version=fork)
+    # every operator signs the config terms + their ENR with the identity
+    # key pinned in that ENR (reference: cluster EIP-712 signatures)
+    from .cluster.definition import sign_operator
+
+    for i, nid in enumerate(identities):
+        definition = sign_operator(definition, i, nid)
 
     tsses, shares_by_val = [], []
     for _ in range(args.num_validators):

@@ -77,3 +77,41 @@ def test_golden_wire_parsig_set():
     rduty, rset = serialize.decode_parsig_set(encoded)
     assert rduty == duty
     assert rset["0x" + "ab" * 48].share_idx == 2
+
+
+def test_operator_signatures_sign_and_verify():
+    """Operator config/ENR signatures (reference: cluster/eip712sigs.go):
+    signed definitions verify; any tamper fails."""
+    import pytest as _pytest
+
+    from charon_tpu.cluster.definition import (sign_operator,
+                                               verify_definition_signatures)
+    from charon_tpu.p2p import identity as ident
+
+    ids = [ident.NodeIdentity.generate(seed=bytes([i])) for i in range(4)]
+    d = Definition(
+        name="sig-cluster",
+        operators=tuple(
+            Operator(address=f"op{i}", enr=n.enr("10.0.0.1", 16000 + i))
+            for i, n in enumerate(ids)),
+        threshold=3, num_validators=1)
+    for i, n in enumerate(ids):
+        d = sign_operator(d, i, n)
+    verify_definition_signatures(d)  # all good
+
+    # tampered ENR fails
+    bad_ops = list(d.operators)
+    other = ident.NodeIdentity.generate(seed=b"\xff")
+    bad_ops[1] = Operator(address="op1", enr=other.enr("10.0.0.1", 16001),
+                          config_signature=d.operators[1].config_signature,
+                          enr_signature=d.operators[1].enr_signature)
+    from dataclasses import replace as _replace
+
+    with _pytest.raises(ValueError):
+        verify_definition_signatures(_replace(d, operators=tuple(bad_ops)))
+    # missing signature fails
+    with _pytest.raises(ValueError):
+        verify_definition_signatures(
+            _replace(d, operators=tuple(
+                Operator(address=o.address, enr=o.enr)
+                for o in d.operators)))
