@@ -252,7 +252,8 @@ class App:
             keys = self._load_vmock_keys(cfg.keystore_dir, pubshares)
             self.vmock = ValidatorMock(vapi, keys, fork,
                                        genesis_validators_root=gvr,
-                                       slots_per_epoch=self.slots_per_epoch)
+                                       slots_per_epoch=self.slots_per_epoch,
+                                       eth2cl=self.eth2cl)
             sched.subscribe_slots(self.vmock.on_slot)
 
         self._register_lifecycle()

@@ -66,29 +66,63 @@ class Definition:
         return [(i, op.enr) for i, op in enumerate(self.operators)]
 
 
-_DEF_SSZ = ssz.Container([
-    ("name", ssz.ByteList(256)),
-    ("version", ssz.ByteList(16)),
-    ("threshold", ssz.uint64),
-    ("num_validators", ssz.uint64),
-    ("fork_version", ssz.Bytes4),
-    ("dkg_algorithm", ssz.ByteList(32)),
-    ("operators", ssz.List(Operator.SSZ, 256)),
+# Signed operator entry: the full definition hash commits to the operator
+# signatures (reference: cluster/ssz.go hashes signed operators into the
+# definition hash), while the CONFIG hash — the thing each operator signs —
+# excludes them to avoid circularity.
+_SIGNED_OP_SSZ = ssz.Container([
+    ("address", ssz.ByteList(64)),
+    ("enr", ssz.ByteList(256)),
+    ("config_signature", ssz.ByteList(96)),
+    ("enr_signature", ssz.ByteList(96)),
 ])
 
 
-def definition_hash(d: Definition) -> bytes:
-    """SSZ tree root of the definition (reference: cluster/ssz.go
-    hashDefinition)."""
-    return _DEF_SSZ.hash_tree_root({
+def _def_fields(d: Definition, signed: bool) -> dict:
+    return {
         "name": d.name.encode(),
         "version": d.version.encode(),
         "threshold": d.threshold,
         "num_validators": d.num_validators,
         "fork_version": d.fork_version,
         "dkg_algorithm": d.dkg_algorithm.encode(),
-        "operators": [op.ssz_value() for op in d.operators],
-    })
+        "operators": [
+            ({**op.ssz_value(),
+              "config_signature": op.config_signature,
+              "enr_signature": op.enr_signature}
+             if signed else op.ssz_value())
+            for op in d.operators],
+    }
+
+
+def _def_ssz(signed: bool) -> ssz.Container:
+    return ssz.Container([
+        ("name", ssz.ByteList(256)),
+        ("version", ssz.ByteList(16)),
+        ("threshold", ssz.uint64),
+        ("num_validators", ssz.uint64),
+        ("fork_version", ssz.Bytes4),
+        ("dkg_algorithm", ssz.ByteList(32)),
+        ("operators", ssz.List(_SIGNED_OP_SSZ if signed else Operator.SSZ,
+                               256)),
+    ])
+
+
+_CONFIG_SSZ = _def_ssz(signed=False)
+_DEF_SSZ = _def_ssz(signed=True)
+
+
+def config_hash(d: Definition) -> bytes:
+    """SSZ tree root over the configuration TERMS (signatures excluded) —
+    the message each operator signs (reference: cluster config hash)."""
+    return _CONFIG_SSZ.hash_tree_root(_def_fields(d, signed=False))
+
+
+def definition_hash(d: Definition) -> bytes:
+    """SSZ tree root of the FULL definition including operator signatures
+    (reference: cluster/ssz.go hashDefinition) — what the lock references,
+    so signature stripping changes every downstream hash."""
+    return _DEF_SSZ.hash_tree_root(_def_fields(d, signed=True))
 
 
 @dataclass(frozen=True)
@@ -171,11 +205,12 @@ _ENR_SIG_CTX = b"charon-tpu/enr-signature/v1"
 
 
 def sign_operator(d: Definition, op_index: int, identity) -> Definition:
-    """Operator `op_index` signs the definition hash (config terms) and
-    their own ENR with their identity key; returns the updated Definition
-    (reference: cluster/definition.go signing flow)."""
+    """Operator `op_index` signs the CONFIG hash (signature-free terms,
+    identical for every signer) and their own ENR with their identity key;
+    returns the updated Definition (reference: cluster/definition.go
+    signing flow)."""
     op = d.operators[op_index]
-    cfg_sig = identity.sign(_CONFIG_SIG_CTX + definition_hash(d))
+    cfg_sig = identity.sign(_CONFIG_SIG_CTX + config_hash(d))
     enr_sig = identity.sign(_ENR_SIG_CTX + op.enr.encode())
     ops = list(d.operators)
     ops[op_index] = replace(op, config_signature=cfg_sig,
@@ -186,10 +221,11 @@ def sign_operator(d: Definition, op_index: int, identity) -> Definition:
 def verify_definition_signatures(d: Definition) -> None:
     """Verify every operator's config + ENR signature against the Ed25519
     key in their own ENR record (reference: cluster/definition.go:158-248
-    VerifySignatures).  Raises on any missing/invalid signature."""
+    VerifySignatures).  Raises on any missing/invalid signature — absence
+    is an error, never a silent skip."""
     from ..p2p import identity as ident
 
-    h = definition_hash(d)
+    h = config_hash(d)
     for i, op in enumerate(d.operators):
         pub, _, _ = ident.enr_parse(op.enr)
         if not op.config_signature or not op.enr_signature:

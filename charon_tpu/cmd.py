@@ -107,6 +107,18 @@ def main(argv: list[str] | None = None) -> int:
     cd.add_argument("--dkg-algorithm", default="default")
     cd.add_argument("--output-file", default="cluster-definition.json")
 
+    # -- sign ---------------------------------------------------------------
+    signp = sub.add_parser(
+        "sign",
+        help="sign your operator entry in a cluster definition "
+             "(each operator runs this before the DKG)")
+    signp.add_argument("--definition-file",
+                       default=_env("definition-file",
+                                    "cluster-definition.json"))
+    signp.add_argument("--identity-key-file",
+                       default=_env("identity-key-file",
+                                    ".charon/charon-enr-private-key"))
+
     # -- combine ------------------------------------------------------------
     comb = sub.add_parser(
         "combine",
@@ -133,6 +145,7 @@ def main(argv: list[str] | None = None) -> int:
         "run": _cmd_run,
         "dkg": _cmd_dkg,
         "create": _cmd_create,
+        "sign": _cmd_sign,
         "combine": _cmd_combine,
         "enr": _cmd_enr,
         "version": _cmd_version,
@@ -214,8 +227,10 @@ def _cmd_dkg(args) -> int:
 
     async def main() -> None:
         definition = definition_from_json(load_json(args.definition_file))
-        if not args.no_verify and any(
-                op.config_signature for op in definition.operators):
+        if not args.no_verify:
+            # Default-ON: a stripped/unsigned definition is an ERROR, not a
+            # silent skip — otherwise a MITM bypasses verification by
+            # deleting signatures.  --no-verify is the only opt-out.
             from .cluster.definition import verify_definition_signatures
 
             verify_definition_signatures(definition)
@@ -365,6 +380,35 @@ def _create_dkg(args) -> int:
         dkg_algorithm=args.dkg_algorithm)
     save_json(args.output_file, definition_to_json(definition))
     print(f"wrote {args.output_file}")
+    return 0
+
+
+def _cmd_sign(args) -> int:
+    """Sign this operator's entry in a shared cluster definition — the
+    distributed-flow counterpart of create-cluster's local signing: each
+    operator runs `sign` on the definition file, then operators exchange /
+    merge the signed file before `dkg` (which verifies default-on)."""
+    from .cluster.definition import (definition_from_json,
+                                     definition_to_json, load_json,
+                                     save_json, sign_operator)
+    from .p2p import identity as ident
+
+    definition = definition_from_json(load_json(args.definition_file))
+    with open(args.identity_key_file) as f:
+        nid = ident.NodeIdentity.from_bytes(bytes.fromhex(f.read().strip()))
+    op_index = None
+    for i, op in enumerate(definition.operators):
+        pub, _, _ = ident.enr_parse(op.enr)
+        if pub == nid.pubkey:
+            op_index = i
+            break
+    if op_index is None:
+        print("error: identity key does not match any operator ENR",
+              file=sys.stderr)
+        return 1
+    definition = sign_operator(definition, op_index, nid)
+    save_json(args.definition_file, definition_to_json(definition))
+    print(f"signed operator {op_index} in {args.definition_file}")
     return 0
 
 

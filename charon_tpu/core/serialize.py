@@ -36,7 +36,8 @@ _register(
     types.AggregatedAttestationUD, types.SyncContributionUD,
     types.SignedAttestation, types.SignedBlock, types.SignedRandao,
     types.SignedExit, types.SignedRegistration,
-    types.SignedBeaconCommitteeSelection, types.SignedAggregateAndProofSD,
+    types.SignedBeaconCommitteeSelection, types.SignedSyncCommitteeSelection,
+    types.SignedAggregateAndProofSD,
     types.SignedSyncMessage, types.SignedSyncContributionAndProof,
     spec.Checkpoint, spec.AttestationData, spec.Attestation,
     spec.BeaconBlock, spec.SignedBeaconBlock, spec.VoluntaryExit,

@@ -100,6 +100,7 @@ def test_cli_create_cluster_and_run(tmp_path):
             while time.time() < deadline:
                 await asyncio.sleep(0.1)
                 if bmock.attestations and bmock.blocks and \
+                        bmock.sync_contributions and \
                         any(r.success for a in apps
                             for r in a.tracker.reports):
                     await asyncio.sleep(3 * SLOT_DUR)  # settle + GC
@@ -114,6 +115,11 @@ def test_cli_create_cluster_and_run(tmp_path):
                     tbls.verify(v.public_key, root, att.signature)
                     for v in lock.validators), "bad group signature"
             assert bmock.blocks, "no block proposals from the full app"
+            # sync family crosses the REAL mesh (wire-codec regression
+            # guard: SignedSyncCommitteeSelection must serialize)
+            assert bmock.sync_messages, "no sync messages via the app"
+            assert bmock.sync_contributions, \
+                "no sync contributions via the app"
 
             # --- monitoring: /readyz ok, /metrics has content ---
             app0 = apps[0]
@@ -178,3 +184,38 @@ def test_cli_create_cluster_and_run(tmp_path):
             await server.stop()
 
     asyncio.run(main())
+
+
+def test_cli_create_dkg_and_sign_flow(tmp_path):
+    """Distributed signing flow: `create dkg` emits an unsigned definition,
+    each operator signs their entry with `sign`, and the result passes
+    default-on verification (dkg refuses unsigned/stripped definitions)."""
+    from charon_tpu.cluster.definition import (definition_from_json,
+                                               load_json,
+                                               verify_definition_signatures)
+    from charon_tpu.p2p import identity as ident
+
+    ids = [ident.NodeIdentity.generate(seed=b"dkgsign" + bytes([i]))
+           for i in range(3)]
+    keyfiles = []
+    for i, nid in enumerate(ids):
+        kf = str(tmp_path / f"key{i}")
+        with open(kf, "w") as f:
+            f.write(nid.to_bytes().hex())
+        keyfiles.append(kf)
+    enrs = ",".join(nid.enr("127.0.0.1", 29000 + i)
+                    for i, nid in enumerate(ids))
+    deff = str(tmp_path / "cluster-definition.json")
+    assert cli_main(["create", "dkg", "--operator-enrs", enrs,
+                     "--threshold", "2", "--output-file", deff]) == 0
+
+    # unsigned definition must FAIL verification (no silent bypass)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        verify_definition_signatures(definition_from_json(load_json(deff)))
+
+    for kf in keyfiles:
+        assert cli_main(["sign", "--definition-file", deff,
+                         "--identity-key-file", kf]) == 0
+    verify_definition_signatures(definition_from_json(load_json(deff)))
