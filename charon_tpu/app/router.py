@@ -33,13 +33,16 @@ class VapiRouter:
 
     def __init__(self, vapi: ValidatorAPI, beacon_addr: str,
                  pubkey_by_index=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, fee_recipient: str = "0x" + "00" * 20,
+                 builder_api: bool = False):
         """`beacon_addr` is the upstream BN base URL for the proxy;
         `pubkey_by_index` optionally resolves validator_index → group
         PubKey (used by voluntary exits, reference SubmitVoluntaryExit)."""
         self.vapi = vapi
         self.beacon_addr = beacon_addr.rstrip("/")
         self._pubkey_by_index = pubkey_by_index
+        self.fee_recipient = fee_recipient
+        self.builder_api = builder_api
         self._host, self._port = host, port
         self._runner: web.AppRunner | None = None
         self._proxy_session: aiohttp.ClientSession | None = None
@@ -67,6 +70,7 @@ class VapiRouter:
                    self._bcomm_selections)
         r.add_post("/eth/v1/validator/sync_committee_selections",
                    self._sync_selections)
+        r.add_get("/teku_proposer_config", self._teku_proposer_config)
         # -- pubkey-mapped passthroughs (validatorapi.go:980-1014) ----------
         r.add_get("/eth/v1/beacon/states/{state}/validators",
                   self._validators)
@@ -203,6 +207,25 @@ class VapiRouter:
         out = await self.vapi.submit_sync_committee_selections(sels)
         return web.json_response(
             {"data": [api.sync_selection_json(s) for s in out]})
+
+    async def _teku_proposer_config(self, request) -> web.Response:
+        """Teku proposer-config endpoint (reference:
+        core/validatorapi/teku.go): maps each PUBSHARE to its proposer
+        settings so Teku VCs configure fee recipients per share key."""
+        entries = {}
+        for group_pk, share in self.vapi._pubshare_by_group.items():
+            entries[api.hex_of(share)] = {
+                "fee_recipient": self.fee_recipient,
+                "builder": {"enabled": self.builder_api,
+                            "gas_limit": "30000000"},
+            }
+        return web.json_response({
+            "proposer_config": entries,
+            "default_config": {
+                "fee_recipient": self.fee_recipient,
+                "builder": {"enabled": self.builder_api},
+            },
+        })
 
     # -- pubkey-mapped passthroughs ----------------------------------------
 

@@ -214,3 +214,40 @@ def test_http_simnet():
             "reverse proxy never exercised"
 
     asyncio.run(main())
+
+
+def test_teku_proposer_config():
+    """Teku proposer-config endpoint maps pubshares to proposer settings
+    (reference: core/validatorapi/teku.go)."""
+
+    async def main():
+        import aiohttp
+
+        from charon_tpu.core.validatorapi import ValidatorAPI
+
+        cluster = new_cluster_for_test(2, 3, 2)
+        bmock = BeaconMock()
+        server = BeaconMockServer(bmock)
+        await server.start()
+        vapi = ValidatorAPI(share_idx=1,
+                            pubshare_by_group=cluster.pubshare_map(1),
+                            fork_version=FORK)
+        router = VapiRouter(vapi, server.addr,
+                            fee_recipient="0x" + "ab" * 20)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(router.addr
+                                 + "/teku_proposer_config") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+            shares = {("0x" + v.pubshares[1].hex())
+                      for v in cluster.validators}
+            assert set(body["proposer_config"]) == shares
+            assert body["default_config"]["fee_recipient"] == \
+                "0x" + "ab" * 20
+        finally:
+            await router.stop()
+            await server.stop()
+
+    asyncio.run(main())
