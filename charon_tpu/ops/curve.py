@@ -239,18 +239,34 @@ def scalars_to_bits(scalars) -> np.ndarray:
 
 
 def scalar_mul(F: FieldOps, pt, bits):
-    """Batched double-and-add, MSB-first.  `pt` [..., 3, elem], `bits`
-    [..., nbits] int32 (any static bit width — 256 for full scalars, 64 for
-    the BLS-parameter multiplications in subgroup checks).  Constant trip
-    count, branch-free: XLA-friendly."""
+    """Batched 2-bit-windowed double-and-add, MSB-first.  `pt` [..., 3,
+    elem], `bits` [..., nbits] int32 (any static bit width — 256 for full
+    scalars, 64 for the BLS-parameter multiplications in subgroup checks).
+
+    Per window: 2 doublings + ONE complete addition of a table entry
+    selected from {∞, P, 2P, 3P} — the complete formulas make adding ∞ a
+    no-op, so the zero window needs no extra select, and the plain
+    double-and-add's second addition per 2 bits disappears (~25% fewer
+    field multiplies).  Constant trip count, branch-free."""
+    nbits = bits.shape[-1]
+    if nbits % 2:
+        pad = [(0, 0)] * (bits.ndim - 1) + [(1, 0)]
+        bits = jnp.pad(bits, pad)
+        nbits += 1
+    batch = pt.shape[: pt.ndim - (F.elem_ndim + 1)]
+    inf = inf_point(F, batch)
+    p2 = double_point(F, pt)
+    p3 = add_points(F, p2, pt)
 
     def body(i, acc):
-        acc = double_point(F, acc)
-        added = add_points(F, acc, pt)
-        return point_select(F, bits[..., i] == 1, added, acc)
+        acc = double_point(F, double_point(F, acc))
+        w = bits[..., 2 * i] * 2 + bits[..., 2 * i + 1]
+        addend = point_select(F, w == 1, pt,
+                              point_select(F, w == 2, p2,
+                                           point_select(F, w == 3, p3, inf)))
+        return add_points(F, acc, addend)
 
-    return lax.fori_loop(0, bits.shape[-1], body,
-                         inf_point(F, pt.shape[: pt.ndim - (F.elem_ndim + 1)]))
+    return lax.fori_loop(0, nbits // 2, body, inf)
 
 
 def sum_points(F: FieldOps, pts, axis: int = 0):
