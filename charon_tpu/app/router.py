@@ -242,11 +242,20 @@ class VapiRouter:
                 "POST", f"/eth/v1/beacon/states/{state}/validators",
                 json_body={"ids": ids})
         else:
-            params = dict(request.query)
-            if "id" in params:
-                params["id"] = ",".join(
-                    self._group_for_share(i) if i.startswith("0x") else i
-                    for i in params["id"].split(","))
+            # the beacon API allows REPEATED id= params as well as
+            # comma-separated values; dict(query) would drop all but the
+            # first repeat (round-3 advisor finding) — rebuild as a
+            # multi-value list instead.
+            params: list[tuple[str, str]] = []
+            for key in dict.fromkeys(request.query.keys()):
+                values = request.query.getall(key)
+                if key == "id":
+                    mapped = ",".join(
+                        self._group_for_share(i) if i.startswith("0x") else i
+                        for raw in values for i in raw.split(","))
+                    params.append((key, mapped))
+                else:
+                    params.extend((key, v) for v in values)
             upstream = await self._upstream_json(
                 "GET", f"/eth/v1/beacon/states/{state}/validators",
                 params=params)

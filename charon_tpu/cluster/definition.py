@@ -173,6 +173,11 @@ def verify_lock(lock: Lock) -> None:
     key (keycast/DKG output); absence is an error unless the definition
     has no validators."""
     d = lock.definition
+    # The reference verifies the embedded definition's operator signatures
+    # FIRST (cluster/lock.go:137-138 Lock.VerifySignatures → Definition.
+    # VerifySignatures): a lock whose operator signatures were stripped or
+    # forged must be rejected on the `run` path too, not only during dkg.
+    verify_definition_signatures(d)
     if len(lock.validators) != d.num_validators:
         raise ValueError("validator count mismatch")
     for v in lock.validators:
@@ -258,19 +263,36 @@ def definition_to_json(d: Definition) -> dict:
     }
 
 
+def _hex_bytes(value: str, field_name: str, length: int | None = None) -> bytes:
+    """Strict 0x-hex decoder: a missing prefix must be an error, not two
+    silently dropped characters (round-3 advisor finding)."""
+    if not isinstance(value, str) or not value.startswith("0x"):
+        raise ValueError(f"{field_name}: expected 0x-prefixed hex")
+    try:
+        out = bytes.fromhex(value[2:])
+    except ValueError:
+        raise ValueError(f"{field_name}: invalid hex") from None
+    if length is not None and out and len(out) != length:
+        raise ValueError(f"{field_name}: expected {length} bytes, "
+                         f"got {len(out)}")
+    return out
+
+
 def definition_from_json(obj: dict) -> Definition:
     d = Definition(
         name=obj["name"],
         operators=tuple(
             Operator(address=o["address"], enr=o.get("enr", ""),
-                     config_signature=bytes.fromhex(
-                         o.get("config_signature", "0x")[2:]),
-                     enr_signature=bytes.fromhex(
-                         o.get("enr_signature", "0x")[2:]))
+                     config_signature=_hex_bytes(
+                         o.get("config_signature", "0x"),
+                         "config_signature", 64),
+                     enr_signature=_hex_bytes(
+                         o.get("enr_signature", "0x"),
+                         "enr_signature", 64))
             for o in obj["operators"]),
         threshold=obj["threshold"],
         num_validators=obj["num_validators"],
-        fork_version=bytes.fromhex(obj["fork_version"][2:]),
+        fork_version=_hex_bytes(obj["fork_version"], "fork_version", 4),
         dkg_algorithm=obj.get("dkg_algorithm", "default"),
         timestamp=obj.get("timestamp", ""),
         version=obj.get("version", VERSION),
@@ -298,12 +320,14 @@ def lock_from_json(obj: dict, verify: bool = True) -> Lock:
         definition=definition_from_json(obj["cluster_definition"]),
         validators=tuple(
             DistValidator(
-                public_key=bytes.fromhex(
-                    v["distributed_public_key"][2:]),
-                public_shares=tuple(bytes.fromhex(s[2:])
-                                    for s in v["public_shares"]))
+                public_key=_hex_bytes(v["distributed_public_key"],
+                                      "distributed_public_key", 48),
+                public_shares=tuple(
+                    _hex_bytes(s, "public_share", 48)
+                    for s in v["public_shares"]))
             for v in obj["distributed_validators"]),
-        signature_aggregate=bytes.fromhex(obj["signature_aggregate"][2:]),
+        signature_aggregate=_hex_bytes(obj["signature_aggregate"],
+                                       "signature_aggregate"),
     )
     want = obj.get("lock_hash")
     if want is not None and want != "0x" + lock_hash(lock).hex():

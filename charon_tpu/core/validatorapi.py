@@ -36,10 +36,15 @@ class ValidatorAPI:
                  pubshare_by_group: dict[PubKey, bytes],
                  fork_version: bytes,
                  genesis_validators_root: bytes = bytes(32),
-                 slots_per_epoch: int = 32):
+                 slots_per_epoch: int = 32,
+                 verifier=None):
         """`pubshare_by_group` maps group pubkey (hex PubKey) → this node's
-        48-byte pubshare for that validator."""
+        48-byte pubshare for that validator.  `verifier` is an optional
+        core.verify.BatchVerifier: when set, partial-sig verification is
+        micro-batched across concurrent submissions into one device launch
+        (otherwise each call is a direct tbls.verify)."""
         self._share_idx = share_idx
+        self._verifier = verifier
         self._pubshare_by_group = dict(pubshare_by_group)
         self._group_by_pubshare = {
             v: k for k, v in pubshare_by_group.items()}
@@ -71,17 +76,24 @@ class ValidatorAPI:
 
     # -- helpers ------------------------------------------------------------
 
-    def _verify_partial(self, group_pubkey: PubKey, signed, epoch_hint=None):
+    async def _verify_partial(self, group_pubkey: PubKey, signed,
+                              epoch_hint=None):
         """Verify a VC submission against this node's pubshare
         (reference: validatorapi.go:1052-1068): recompute the domain-wrapped
-        signing root and pairing-verify."""
+        signing root and pairing-verify — through the shared BatchVerifier
+        when wired, so concurrent submissions across all validators share
+        one batched pairing launch."""
         pubshare = self._pubshare_by_group.get(group_pubkey)
         if pubshare is None:
             raise VapiError(f"unknown validator {group_pubkey}")
         domain, epoch = signed.signing_info(self._spe)
         root = signing_root(domain, signed.message_root(), self._fork_version,
                             self._gvr)
-        if not tbls.verify(pubshare, root, signed.signature):
+        if self._verifier is not None:
+            ok = await self._verifier.verify(pubshare, root, signed.signature)
+        else:
+            ok = tbls.verify(pubshare, root, signed.signature)
+        if not ok:
             raise VapiError("invalid partial signature")
 
     async def _push(self, duty: Duty, group_pubkey: PubKey, signed) -> None:
@@ -110,7 +122,7 @@ class ValidatorAPI:
             group_pk = await self._pubkey_by_attestation(
                 att.data.slot, att.data.index, val_comm_idx)
             signed = SignedAttestation(attestation=att)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             duty = Duty(att.data.slot, DutyType.ATTESTER)
             await self._push(duty, group_pk, signed)
 
@@ -130,7 +142,7 @@ class ValidatorAPI:
         # 2. verify + store the partial RANDAO reveal
         randao = SignedRandao(epoch=slot // self._spe,
                               signature=randao_reveal)
-        self._verify_partial(group_pk, randao)
+        await self._verify_partial(group_pk, randao)
         await self._push(Duty(slot, DutyType.RANDAO), group_pk, randao)
         # 3. block until consensus provides the unsigned block (fetcher
         #    blocks on aggregated randao internally)
@@ -146,7 +158,7 @@ class ValidatorAPI:
             raise VapiError(f"no proposer duty for slot {block.message.slot}")
         [group_pk] = list(defset)[:1]
         signed = SignedBlock(block=block)
-        self._verify_partial(group_pk, signed)
+        await self._verify_partial(group_pk, signed)
         await self._push(duty, group_pk, signed)
 
     # -- voluntary exit (validatorapi.go SubmitVoluntaryExit) ---------------
@@ -154,7 +166,7 @@ class ValidatorAPI:
     async def submit_voluntary_exit(self, exit_: spec.SignedVoluntaryExit,
                                     group_pubkey: PubKey) -> None:
         signed = SignedExit(exit=exit_)
-        self._verify_partial(group_pubkey, signed)
+        await self._verify_partial(group_pubkey, signed)
         duty = Duty(exit_.message.epoch * self._spe, DutyType.EXIT)
         await self._push(duty, group_pubkey, signed)
 
@@ -174,7 +186,7 @@ class ValidatorAPI:
             except VapiError:
                 group_pk = pubkey_from_bytes(reg.message.pubkey)
             signed = SignedRegistration(registration=reg)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             duty = Duty(0, DutyType.BUILDER_REGISTRATION)
             await self._push(duty, group_pk, signed)
 
@@ -192,7 +204,7 @@ class ValidatorAPI:
                 Duty(sel.slot, DutyType.ATTESTER))
             group_pk = _pubkey_by_validator_index(defset, sel.validator_index)
             signed = SignedBeaconCommitteeSelection(selection=sel)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             await self._push(duty, group_pk, signed)
             agg = await self._await_agg_sig_db(duty, group_pk)
             out.append(agg.selection)
@@ -207,7 +219,7 @@ class ValidatorAPI:
             defset = await self._get_duty_definition(duty)
             group_pk = _pubkey_by_validator_index(defset, msg.validator_index)
             signed = SignedSyncMessage(message=msg)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             await self._push(duty, group_pk, signed)
 
     async def submit_sync_contributions(
@@ -222,7 +234,7 @@ class ValidatorAPI:
             group_pk = _pubkey_by_validator_index(
                 defset, c.message.aggregator_index)
             signed = SignedSyncContributionAndProof(contribution=c)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             await self._push(duty, group_pk, signed)
 
     async def submit_sync_committee_selections(
@@ -237,7 +249,7 @@ class ValidatorAPI:
                 Duty(sel.slot, DutyType.SYNC_MESSAGE))
             group_pk = _pubkey_by_validator_index(defset, sel.validator_index)
             signed = SignedSyncCommitteeSelection(selection=sel)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             await self._push(duty, group_pk, signed)
             agg = await self._await_agg_sig_db(duty, group_pk)
             out.append(agg.selection)
@@ -254,7 +266,7 @@ class ValidatorAPI:
             group_pk = _pubkey_by_validator_index(
                 defset, agg.message.aggregator_index)
             signed = SignedAggregateAndProofSD(agg=agg)
-            self._verify_partial(group_pk, signed)
+            await self._verify_partial(group_pk, signed)
             await self._push(duty, group_pk, signed)
 
 

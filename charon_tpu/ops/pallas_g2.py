@@ -1,0 +1,449 @@
+"""Fused Pallas TPU kernels for whole G2 group-law steps.
+
+Round-3 profiling showed the Lagrange-combine MSM (the `core/sigagg` hot
+call, reference: tbls/tss.go:142-149 via core/sigagg/sigagg.go:75-77) was
+dominated not by field arithmetic but by per-op overhead: every fp-level
+pallas call re-tiled its operands (layout transposes through HBM), and one
+G2 point addition is ~66 separate device ops.  These kernels remove both
+overheads:
+
+- Elements live in a PERSISTENT limbs-major tiled layout end-to-end:
+  an Fp residue batch is `[NLIMBS, S, 128]` (rows on the trailing two
+  axes, S a multiple of 8), a G2 point batch is `[6, NLIMBS, S, 128]`
+  with planes (X0, X1, Y0, Y1, Z0, Z1).  Tiling happens ONCE per combine
+  at the decompress/normalize boundaries.
+- One kernel computes one COMPLETE group-law step (Renes–Costello–Batina
+  a = 0 complete addition/doubling, same formulas as ops/curve.py) with
+  every intermediate held in VMEM: per 8×128-row grid block the kernel
+  reads the operand points and writes only the result point — HBM traffic
+  is inputs + outputs instead of one round-trip per field op.
+- `dblsel` fuses a whole 2-bit MSM iteration: two complete doublings,
+  the window-table select (P/2P/3P; window 0 keeps the doubled
+  accumulator), and the complete addition — one launch per iteration.
+- Fp2 products use lazy Karatsuba: the three sub-products are combined at
+  convolution-column level (with a spread multiple-of-p offset keeping
+  columns nonnegative), so each Fp2 product pays two fold-reductions
+  instead of three full and two small ones.
+
+Field arithmetic is the proven redundant-residue design of ops/fp.py
+(12-bit limbs, conv products, fold-reduction; see fp._reduce for the
+convergence proof — the lazy path's larger start value gets one extra
+contraction round).  Fold constants enter the kernel as a broadcast
+input tensor (`fc`) because Pallas forbids captured array constants.
+The jnp path remains the correctness oracle — the differential test runs
+these kernels in pallas interpret mode against it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import fp
+
+NL = fp.NLIMBS
+MASK = fp.MASK
+LANES = 128
+SUBLANES = 8
+
+# Set by tests to run kernels in pallas interpret mode (CPU validation).
+INTERPRET = False
+
+
+# ---------------------------------------------------------------------------
+# Host-side constants
+# ---------------------------------------------------------------------------
+
+def _spread_multiple(width: int, min_digit: int) -> np.ndarray:
+    """A multiple of p as `width + 1` nonnegative digits with every digit
+    below `width` at least `min_digit` (so columnwise subtraction of any
+    vector with columns < min_digit stays nonnegative).  Same trick as
+    fp.SPREAD48P, generalised."""
+    from ..tbls.ref.fields import P
+
+    k = ((min_digit * 4) << (12 * (width - 1))) // P + 2
+    digits = [int(d) for d in fp.to_limbs(k * P, width + 1)]
+    for i in range(width):
+        while digits[i] < min_digit:
+            digits[i] += 1 << 12
+            digits[i + 1] -= 1
+    assert all(d >= 0 for d in digits)
+    assert sum(d << (12 * i) for i, d in enumerate(digits)) == k * P
+    return np.asarray(digits, np.int64)
+
+
+# Offsets for the lazy Karatsuba combines: columns after two carry rounds
+# are < 2^13, and c1 subtracts two such vectors.
+_OFF1 = _spread_multiple(65, 1 << 13)      # 66 digits
+_OFF2 = _spread_multiple(65, 1 << 14)      # 66 digits
+
+# Fold-constant table: worst fold width is 68 (66 lazy-combine columns
+# widened by two carry rounds) → 36 high columns.
+_FC_ROWS = 36
+_FC_NP = fp.FOLDC[:_FC_ROWS].astype(np.int32)          # [34, 32]
+
+
+def fold_consts() -> np.ndarray:
+    """The `fc` kernel input: fold constants broadcast to vreg shape."""
+    return np.ascontiguousarray(
+        np.broadcast_to(_FC_NP[:, :, None, None],
+                        (_FC_ROWS, NL, SUBLANES, LANES)))
+
+
+_SPREAD = [int(v) for v in fp.SPREAD48P]               # 33 digits
+
+
+# ---------------------------------------------------------------------------
+# In-kernel field library.  An Fp element is a [W, 8, 128] int32 array
+# (limb axis leading); an Fp2 element is a (c0, c1) tuple.  `fc` is the
+# fold-constant array read from the kernel input.
+# ---------------------------------------------------------------------------
+
+def _zrow(x, n=1):
+    return jnp.zeros((n,) + x.shape[1:], jnp.int32)
+
+
+def _pc(x, rounds):
+    """Data-parallel partial carries; widens by one limb per round."""
+    for _ in range(rounds):
+        lo = x & MASK
+        hi = x >> fp.LIMB_BITS
+        x = (jnp.concatenate([lo, _zrow(x)], axis=0)
+             + jnp.concatenate([_zrow(x), hi], axis=0))
+    return x
+
+
+def _fold(fc, x):
+    """[W ≥ 32, 8, 128] → [32, 8, 128], value preserved mod p."""
+    h = x.shape[0] - NL
+    assert h <= _FC_ROWS
+    acc = x[:NL]
+    for j in range(h):
+        acc = acc + x[NL + j][None] * fc[j]
+    return acc
+
+
+def _reduce(fc, x, iters):
+    x = _fold(fc, _pc(x, 2))
+    for _ in range(iters):
+        x = _fold(fc, _pc(x, 2))
+    return x
+
+
+def _addf(fc, a, b):
+    return _reduce(fc, a + b, 1)
+
+
+def _add_off(cols, off):
+    """Add per-column integer literals (a spread multiple of p)."""
+    w = cols.shape[0]
+    out = [cols[i] + int(off[i]) for i in range(w)]
+    out.append(jnp.full(cols.shape[1:], int(off[w]), jnp.int32))
+    return jnp.concatenate([c[None] for c in out], axis=0)
+
+
+def _spread_arr(like):
+    """SPREAD48P (≡ 0 mod p, every low limb ≥ LMAX) as a stack of per-limb
+    literal columns shaped like `like` (33 limbs)."""
+    return jnp.concatenate(
+        [jnp.full((1,) + like.shape[1:], v, jnp.int32) for v in _SPREAD],
+        axis=0)
+
+
+def _subf(fc, a, b):
+    d = jnp.concatenate([a - b, _zrow(a)], axis=0)  # [33, 8, 128]
+    return _reduce(fc, d + _spread_arr(d), 1)
+
+
+def _negf(fc, a):
+    d = _spread_arr(a) - jnp.concatenate([a, _zrow(a)], axis=0)
+    return _reduce(fc, d, 1)
+
+
+def _msmall(fc, a, k):
+    assert 1 <= k <= 16
+    return _reduce(fc, a * k, 2)
+
+
+def _conv(a, b):
+    """63 raw convolution columns (each < 2^31 for limbs ≤ LMAX)."""
+    b_rev = jnp.concatenate([b[j][None] for j in range(NL - 1, -1, -1)])
+    cols = []
+    for k in range(2 * NL - 1):
+        lo, hi = max(0, k - (NL - 1)), min(NL - 1, k)
+        seg = a[lo:hi + 1] * b_rev[NL - 1 - k + lo:NL - 1 - k + hi + 1]
+        cols.append(jnp.sum(seg, axis=0, keepdims=True))
+    return jnp.concatenate(cols, axis=0)
+
+
+def _mulf(fc, a, b):
+    return _reduce(fc, _conv(a, b), 5)
+
+
+def _f2add(fc, a, b):
+    return (_addf(fc, a[0], b[0]), _addf(fc, a[1], b[1]))
+
+
+def _f2sub(fc, a, b):
+    return (_subf(fc, a[0], b[0]), _subf(fc, a[1], b[1]))
+
+
+def _f2small(fc, a, k):
+    return (_msmall(fc, a[0], k), _msmall(fc, a[1], k))
+
+
+def _f2mul(fc, a, b):
+    """Lazy Karatsuba: combine the three sub-products at column level,
+    then ONE fold-reduction per output coefficient.  Start value after
+    the offsets is < 2^400, handled by one extra contraction round."""
+    t0 = _pc(_conv(a[0], b[0]), 2)                       # 65 cols < 2^13
+    t1 = _pc(_conv(a[1], b[1]), 2)
+    t2 = _pc(_conv(_addf(fc, a[0], a[1]), _addf(fc, b[0], b[1])), 2)
+    c0 = _add_off(t0 - t1, _OFF1)                        # 66 cols
+    c1 = _add_off(t2 - t0 - t1, _OFF2)
+    return (_reduce(fc, c0, 6), _reduce(fc, c1, 6))
+
+
+def _f2sqr(fc, a):
+    """(a0+a1)(a0−a1) + 2a0a1·u: two products, no cross combine."""
+    c0 = _mulf(fc, _addf(fc, a[0], a[1]), _subf(fc, a[0], a[1]))
+    t = _pc(_conv(a[0], a[1]), 2)
+    return (c0, _reduce(fc, t * 2, 5))
+
+
+def _f2_mul_b3(fc, a):
+    """×3b = ×12(1+u): ξ-rotation then a small-constant multiple."""
+    return (_msmall(fc, _subf(fc, a[0], a[1]), 12),
+            _msmall(fc, _addf(fc, a[0], a[1]), 12))
+
+
+# ---------------------------------------------------------------------------
+# In-kernel complete group law (RCB16 Algs 7/9, a = 0) — mirrors
+# ops/curve.add_points / double_point exactly.
+# ---------------------------------------------------------------------------
+
+def _pt_unstack(p):
+    """[6, 32, 8, 128] → (x, y, z) Fp2 tuples."""
+    return ((p[0], p[1]), (p[2], p[3]), (p[4], p[5]))
+
+
+def _pt_stack(x, y, z):
+    return jnp.concatenate([c[None] for c in
+                            (x[0], x[1], y[0], y[1], z[0], z[1])], axis=0)
+
+
+def _g2_double(fc, p):
+    x, y, z = _pt_unstack(p)
+    yy = _f2sqr(fc, y)
+    yz = _f2mul(fc, y, z)
+    zz = _f2sqr(fc, z)
+    xy = _f2mul(fc, x, y)
+    bzz = _f2_mul_b3(fc, zz)
+    e8 = _f2small(fc, yy, 8)
+    s = _f2add(fc, yy, bzz)
+    d = _f2sub(fc, yy, _f2small(fc, bzz, 3))
+    x3 = _f2small(fc, _f2mul(fc, d, xy), 2)
+    y3 = _f2add(fc, _f2mul(fc, bzz, e8), _f2mul(fc, d, s))
+    z3 = _f2mul(fc, yz, e8)
+    return _pt_stack(x3, y3, z3)
+
+
+def _g2_add(fc, p1, p2):
+    x1, y1, z1 = _pt_unstack(p1)
+    x2, y2, z2 = _pt_unstack(p2)
+    t0 = _f2mul(fc, x1, x2)
+    t1 = _f2mul(fc, y1, y2)
+    t2 = _f2mul(fc, z1, z2)
+    pxy = _f2mul(fc, _f2add(fc, x1, y1), _f2add(fc, x2, y2))
+    pyz = _f2mul(fc, _f2add(fc, y1, z1), _f2add(fc, y2, z2))
+    pxz = _f2mul(fc, _f2add(fc, x1, z1), _f2add(fc, x2, z2))
+    t3 = _f2sub(fc, pxy, _f2add(fc, t0, t1))         # X1Y2 + X2Y1
+    t4 = _f2sub(fc, pyz, _f2add(fc, t1, t2))         # Y1Z2 + Y2Z1
+    t5 = _f2sub(fc, pxz, _f2add(fc, t0, t2))         # X1Z2 + X2Z1
+    m = _f2small(fc, t0, 3)                          # 3·X1X2
+    bz = _f2_mul_b3(fc, t2)                          # 3b·Z1Z2
+    s = _f2add(fc, t1, bz)
+    d = _f2sub(fc, t1, bz)
+    by = _f2_mul_b3(fc, t5)
+    x3 = _f2sub(fc, _f2mul(fc, t3, d), _f2mul(fc, t4, by))
+    y3 = _f2add(fc, _f2mul(fc, d, s), _f2mul(fc, m, by))
+    z3 = _f2add(fc, _f2mul(fc, t4, s), _f2mul(fc, t3, m))
+    return _pt_stack(x3, y3, z3)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+def _dbl_kernel(fc_ref, p_ref, o_ref):
+    o_ref[...] = _g2_double(fc_ref[...], p_ref[...])
+
+
+def _add_kernel(fc_ref, a_ref, b_ref, o_ref):
+    o_ref[...] = _g2_add(fc_ref[...], a_ref[...], b_ref[...])
+
+
+def _sel(w, t1_ref, t2_ref, t3_ref):
+    return jnp.where(w == 1, t1_ref[...],
+                     jnp.where(w == 2, t2_ref[...], t3_ref[...]))
+
+
+def _addsel_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, w_ref, o_ref):
+    """acc ← acc + table[w] for w ∈ {1,2,3}; w = 0 keeps acc unchanged
+    (cheaper than a complete addition of ∞: select the input back)."""
+    fc = fc_ref[...]
+    w = w_ref[...][None, None, :, :]
+    added = _g2_add(fc, acc_ref[...], _sel(w, t1_ref, t2_ref, t3_ref))
+    o_ref[...] = jnp.where(w == 0, acc_ref[...], added)
+
+
+def _dblsel_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, w_ref, o_ref):
+    """One fused 2-bit MSM iteration: acc ← 4·acc (+ table[w]), every
+    intermediate in VMEM — one launch per iteration."""
+    fc = fc_ref[...]
+    acc4 = _g2_double(fc, _g2_double(fc, acc_ref[...]))
+    w = w_ref[...][None, None, :, :]
+    added = _g2_add(fc, acc4, _sel(w, t1_ref, t2_ref, t3_ref))
+    o_ref[...] = jnp.where(w == 0, acc4, added)
+
+
+@functools.lru_cache(maxsize=8)
+def _calls(s_blocks: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def pt_spec():
+        return pl.BlockSpec((6, NL, SUBLANES, LANES), lambda i: (0, 0, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    fc_spec = pl.BlockSpec((_FC_ROWS, NL, SUBLANES, LANES),
+                           lambda i: (0, 0, 0, 0), memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+
+    def build(kernel, n_pts, with_w):
+        in_specs = [fc_spec] + [pt_spec() for _ in range(n_pts)]
+        if with_w:
+            in_specs.append(w_spec)
+        shape = (6, NL, s_blocks * SUBLANES, LANES)
+        return pl.pallas_call(
+            kernel,
+            grid=(s_blocks,),
+            in_specs=in_specs,
+            out_specs=pt_spec(),
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+            interpret=interpret,
+        )
+
+    return {
+        "dbl": build(_dbl_kernel, 1, False),
+        "add": build(_add_kernel, 2, False),
+        "addsel": build(_addsel_kernel, 4, True),
+        "dblsel": build(_dblsel_kernel, 4, True),
+    }
+
+
+def _get(name: str, s: int):
+    assert s % SUBLANES == 0, f"S={s} must be a multiple of {SUBLANES}"
+    return _calls(s // SUBLANES, INTERPRET)[name]
+
+
+def dbl(fc, p):
+    """[6, 32, S, 128] tiled G2 points → doubled points."""
+    return _get("dbl", p.shape[2])(fc, p)
+
+
+def add(fc, a, b):
+    return _get("add", a.shape[2])(fc, a, b)
+
+
+def addsel(fc, acc, p1, p2, p3, w):
+    return _get("addsel", acc.shape[2])(fc, acc, p1, p2, p3, w)
+
+
+def dblsel(fc, acc, p1, p2, p3, w):
+    return _get("dblsel", acc.shape[2])(fc, acc, p1, p2, p3, w)
+
+
+# ---------------------------------------------------------------------------
+# Tiled layout helpers + MSM driver (jnp level; jit these from the caller)
+# ---------------------------------------------------------------------------
+
+def tile_points(pts):
+    """[R, 3, 2, 32] limb-last points → [6, 32, S, 128] tiled, R = S·128.
+    One transpose per combine instead of two per field op."""
+    r = pts.shape[0]
+    assert r % (SUBLANES * LANES) == 0
+    flat = pts.reshape(r, 6, NL).transpose(1, 2, 0)
+    return flat.reshape(6, NL, r // LANES, LANES)
+
+
+def untile_points(t):
+    """[6, 32, S, 128] → [R, 3, 2, 32]."""
+    s = t.shape[2]
+    flat = t.reshape(6, NL, s * LANES).transpose(2, 0, 1)
+    return flat.reshape(s * LANES, 3, 2, NL)
+
+
+_INF_PLANES = np.zeros((6, NL), np.int32)
+_INF_PLANES[2] = fp.ONE_M  # (0 : 1 : 0)
+
+
+def inf_tiled(s: int):
+    return jnp.broadcast_to(jnp.asarray(_INF_PLANES)[:, :, None, None],
+                            (6, NL, s, LANES))
+
+
+def windows_from_bits(bits: np.ndarray) -> np.ndarray:
+    """Host: [R, nbits] scalar bit planes (MSB first) → [nbits/2, S, 128]
+    2-bit window indices, iteration-major."""
+    r, nbits = bits.shape
+    assert nbits % 2 == 0 and r % LANES == 0
+    w = bits[:, 0::2] * 2 + bits[:, 1::2]           # [R, nbits/2]
+    return np.ascontiguousarray(
+        w.T.reshape(nbits // 2, r // LANES, LANES).astype(np.int32))
+
+
+def msm_rows(fc, pts_t, windows):
+    """Per-row scalar multiplication, entirely in tiled layout:
+    pts_t [6, 32, S, 128], windows [nwin, S, 128] → [6, 32, S, 128].
+    Each iteration is ONE fused kernel launch."""
+    s = pts_t.shape[2]
+    p2 = dbl(fc, pts_t)
+    p3 = add(fc, p2, pts_t)
+    nwin = windows.shape[0]
+
+    def body(i, acc):
+        w = lax.dynamic_index_in_dim(windows, i, 0, keepdims=False)
+        return dblsel(fc, acc, pts_t, p2, p3, w)
+
+    return lax.fori_loop(0, nwin, body, inf_tiled(s))
+
+
+def tree_sum_t(fc, pts_t, t_count: int):
+    """Sum over the T axis of a t-major tiled batch: rows are laid out
+    t·Vpad + v, so component t is a contiguous S-slice.  ⌈log₂T⌉ complete
+    additions."""
+    s = pts_t.shape[2]
+    assert s % t_count == 0
+    sv = s // t_count
+    parts = [pts_t[:, :, k * sv:(k + 1) * sv, :] for k in range(t_count)]
+    while len(parts) > 1:
+        nxt = []
+        for k in range(0, len(parts) - 1, 2):
+            nxt.append(add(fc, parts[k], parts[k + 1]))
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def msm_combine(fc, pts_t, windows, t_count: int):
+    """Full Lagrange-combine MSM: per-row scalar mul then T-axis tree sum.
+    Returns [6, 32, Sv, 128] tiled combined points (Sv = S / t_count)."""
+    return tree_sum_t(fc, msm_rows(fc, pts_t, windows), t_count)

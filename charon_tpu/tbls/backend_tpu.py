@@ -19,6 +19,9 @@ O(log n) times across workload sizes.
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +30,7 @@ from . import shamir
 from ..ops import codec
 from ..ops import curve as jcurve
 from ..ops import pairing as jpair
+from ..ops import pallas_g2
 from ..ops.curve import F2_OPS
 from ..tbls.ref import curve as refcurve
 from ..tbls.ref.hash_to_curve import hash_to_g2
@@ -84,6 +88,33 @@ def _decompress_kernel(xc0, xc1, sign, inf):
 def _msm_normalize_kernel(pts, bits):
     combined = jcurve.msm(F2_OPS, pts, bits, axis=1)
     return codec.g2_normalize(combined)
+
+
+# -- fused-MSM combine path (ops/pallas_g2): persistent limbs-major tiled
+# layout, one fused kernel launch per 2-bit MSM iteration.  Default on TPU
+# backends; CHARON_TPU_FUSED_MSM=0 opts out (CPU tests exercise the same
+# kernels in pallas interpret mode via tests/test_pallas_g2.py).
+
+def _use_fused() -> bool:
+    flag = os.environ.get("CHARON_TPU_FUSED_MSM", "auto")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+@functools.partial(jax.jit, static_argnames=("t_count",))
+def _msm_fused_normalize_kernel(pts, windows, t_count):
+    """pts [T·Vpad, 3, 2, 32] (t-major rows), windows [128, S, 128] →
+    normalized std-form affine planes of the Vpad combined points."""
+    fc = jnp.asarray(pallas_g2.fold_consts())
+    tiled = pallas_g2.tile_points(pts)
+    out = pallas_g2.msm_combine(fc, tiled, windows, t_count)
+    return codec.g2_normalize(pallas_g2.untile_points(out))
 
 
 @jax.jit
@@ -186,6 +217,8 @@ class TPUBackend:
         device launch (reference per-validator CPU path: tbls/tss.go:142-149)."""
         if not batch:
             return []
+        if _use_fused():
+            return self._combine_bytes_fused(batch)
         v = _pad_pow2(len(batch))
         t = _pad_pow2(max(len(sigs) for sigs in batch))
         raw = np.broadcast_to(_G2_INF_BYTES, (v, t, 96)).copy()
@@ -213,6 +246,45 @@ class TPUBackend:
                                    np.asarray(oyc0), np.asarray(oyc1),
                                    np.asarray(oinf))
         return [out[k].tobytes() for k in range(len(batch))]
+
+    def _combine_bytes_fused(self, batch) -> list[bytes]:
+        """Fused-kernel combine: rows laid out T-MAJOR (row = t·Vpad + v,
+        so the T-axis tree sum is contiguous S-slices), validators padded
+        to a 1024-row tile multiple (NOT pow2 — at V = 10k that alone
+        wastes 1.6× work), T exact."""
+        nv = len(batch)
+        vpad = max(1024, -(-nv // 1024) * 1024)
+        t = max(len(sigs) for sigs in batch)
+        raw = np.broadcast_to(_G2_INF_BYTES, (t, vpad, 96)).copy()
+        bits = np.zeros((t, vpad, jcurve.SCALAR_BITS), np.int32)
+        counts = np.zeros(vpad, np.int32)
+        for col, sigs in enumerate(batch):
+            idxs = tuple(sigs)
+            if any(len(sigs[i]) != 96 for i in idxs):
+                raise ValueError("G2 compressed signature must be 96 bytes")
+            sig_bytes = b"".join(sigs[i] for i in idxs)
+            raw[: len(idxs), col] = np.frombuffer(
+                sig_bytes, np.uint8).reshape(len(idxs), 96)
+            bits[: len(idxs), col] = _lagrange_bits(idxs)
+            counts[col] = len(idxs)
+        xc0, xc1, sign, inf, bad = codec.g2_bytes_split(raw.reshape(-1, 96))
+        real = (np.arange(t)[:, None] < counts[None, :]).reshape(-1)
+        if (bad & real).any():
+            raise ValueError("malformed compressed G2 signature in batch")
+        shape = (t * vpad, jcurve.fp.NLIMBS)
+        pts, ok = _decompress_kernel(
+            jnp.asarray(xc0.reshape(shape)), jnp.asarray(xc1.reshape(shape)),
+            jnp.asarray(sign.reshape(-1)), jnp.asarray(inf.reshape(-1)))
+        windows = pallas_g2.windows_from_bits(
+            bits.reshape(-1, jcurve.SCALAR_BITS))
+        oxc0, oxc1, oyc0, oyc1, oinf = _msm_fused_normalize_kernel(
+            pts, jnp.asarray(windows), t)
+        if not (np.asarray(ok) | ~real).all():
+            raise ValueError("signature bytes not on the G2 curve")
+        out = codec.g2_compress_np(np.asarray(oxc0), np.asarray(oxc1),
+                                   np.asarray(oyc0), np.asarray(oyc1),
+                                   np.asarray(oinf))
+        return [out[k].tobytes() for k in range(nv)]
 
     _HM_CACHE: dict[bytes, np.ndarray] = {}
 

@@ -68,6 +68,12 @@ def _run_ceremony(tmp_path, algorithm: str):
                                  enr=ids[i].enr("127.0.0.1", ports[i]))
                         for i in range(n)),
         threshold=t, num_validators=m, dkg_algorithm=algorithm)
+    # every operator signs the config terms + their ENR before the
+    # ceremony, as the reference requires (verify_lock now checks the
+    # embedded definition's signatures, cluster/lock.go:137-138)
+    from charon_tpu.cluster.definition import sign_operator
+    for i in range(n):
+        definition = sign_operator(definition, i, ids[i])
 
     async def main():
         from charon_tpu.cluster.definition import definition_hash
