@@ -22,8 +22,8 @@ from ..core.scheduler import Scheduler
 from ..core.sigagg import SigAgg
 from ..core.types import Duty, ParSignedDataSet, PubKey
 from ..core.validatorapi import ValidatorAPI
+from ..core.verify import BatchVerifier
 from ..eth2util.signing import signing_root
-from ..tbls import api as tbls
 
 
 @dataclass
@@ -51,12 +51,18 @@ class Node:
         self.fetcher = Fetcher(eth2cl)
         self.consensus = consensus
         self.dutydb = MemDutyDB()
+        # Both verify call-sites (local VC submissions + inbound peer
+        # partials) share one micro-batching verifier → one
+        # tbls.batch_verify launch per event-loop tick (reference per-sig
+        # call-sites: validatorapi.go:1052-1068, parsigex.go:152-176).
+        self.verifier = BatchVerifier()
         self.vapi = ValidatorAPI(
             share_idx=cfg.share_idx,
             pubshare_by_group=pubshares,
             fork_version=cfg.fork_version,
             genesis_validators_root=cfg.genesis_validators_root,
-            slots_per_epoch=slots_per_epoch)
+            slots_per_epoch=slots_per_epoch,
+            verifier=self.verifier)
         self.parsigdb = MemParSigDB(cfg.threshold)
         self.parsigex = parsigex
         # Autowire inbound-partial-sig verification on transports that
@@ -82,7 +88,9 @@ class Node:
     async def _verify_external(self, duty: Duty,
                                pset: ParSignedDataSet) -> None:
         """Verify inbound peer partial sigs against the SENDER's pubshare
-        (reference: core/parsigex/parsigex.go:152-176)."""
+        (reference: core/parsigex/parsigex.go:152-176) — the whole message
+        as one verify_many unit through the shared BatchVerifier."""
+        entries = []
         for group_pk, psig in pset.items():
             peer_shares = self.cfg.pubshares_by_peer.get(psig.share_idx)
             if peer_shares is None or group_pk not in peer_shares:
@@ -91,8 +99,9 @@ class Node:
             root = signing_root(domain, psig.data.message_root(),
                                 self.cfg.fork_version,
                                 self.cfg.genesis_validators_root)
-            if not tbls.verify(peer_shares[group_pk], root, psig.signature):
-                raise ValueError("invalid external partial signature")
+            entries.append((peer_shares[group_pk], root, psig.signature))
+        if not all(await self.verifier.verify_many(entries)):
+            raise ValueError("invalid external partial signature")
 
     def start(self) -> None:
         self._run_task = asyncio.get_event_loop().create_task(

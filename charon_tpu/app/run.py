@@ -37,6 +37,7 @@ from ..core.tracker import Tracker
 from ..core.types import (Duty, DutyType, ParSignedDataSet, PubKey,
                           pubkey_from_bytes)
 from ..core.validatorapi import ValidatorAPI
+from ..core.verify import BatchVerifier
 from ..eth2util.beacon_client import MultiBeaconClient
 from ..eth2util.signing import signing_root
 from ..p2p import identity as ident
@@ -166,11 +167,18 @@ class App:
                                   self_index, n,
                                   sniffer=self.qbft_sniffer)
         dutydb = MemDutyDB()
+        # Shared micro-batching verifier: both partial-sig verify call-sites
+        # — local-VC submissions (reference: core/validatorapi/
+        # validatorapi.go:1052-1068) and inbound peer exchange (reference:
+        # core/parsigex/parsigex.go:152-176) — coalesce into one
+        # tbls.batch_verify device launch per event-loop tick.
+        self.verifier = BatchVerifier(on_launch=self._on_verify_launch)
         vapi = ValidatorAPI(share_idx=share_idx,
                             pubshare_by_group=pubshares,
                             fork_version=fork,
                             genesis_validators_root=gvr,
-                            slots_per_epoch=self.slots_per_epoch)
+                            slots_per_epoch=self.slots_per_epoch,
+                            verifier=self.verifier)
         parsigdb = MemParSigDB(threshold)
         parsigex = P2PParSigEx(self.mesh, verify_fn=self._verify_external)
         sigagg = SigAgg(threshold)
@@ -263,7 +271,11 @@ class App:
     async def _verify_external(self, duty: Duty,
                                pset: ParSignedDataSet) -> None:
         """Inbound peer partial-sig verification against the SENDER's
-        pubshare (reference: core/parsigex/parsigex.go:152-176)."""
+        pubshare (reference: core/parsigex/parsigex.go:152-176).  All
+        partials of the message verify as ONE verify_many unit, and the
+        shared BatchVerifier further coalesces concurrent messages (and
+        local-VC submissions) into a single device launch per tick."""
+        entries = []
         for group_pk, psig in pset.items():
             peer_shares = self._pubshares_by_peer.get(psig.share_idx)
             if peer_shares is None or group_pk not in peer_shares:
@@ -271,8 +283,14 @@ class App:
             domain, _ = psig.data.signing_info(self.slots_per_epoch)
             root = signing_root(domain, psig.data.message_root(),
                                 self._fork, self._gvr)
-            if not tbls.verify(peer_shares[group_pk], root, psig.signature):
-                raise ValueError("invalid external partial signature")
+            entries.append((peer_shares[group_pk], root, psig.signature))
+        if not all(await self.verifier.verify_many(entries)):
+            raise ValueError("invalid external partial signature")
+
+    def _on_verify_launch(self, v: BatchVerifier) -> None:
+        self.registry.set_gauge("core_verify_launches_total", v.launches)
+        self.registry.set_gauge("core_verify_entries_total", v.entries_total)
+        self.registry.set_gauge("core_verify_max_batch", v.max_batch)
 
     async def _pubkey_by_index(self, index: int) -> PubKey:
         if not self._index_to_pubkey:

@@ -29,9 +29,10 @@ class _Pending:
 
 
 class BatchVerifier:
-    def __init__(self, flush_interval: float = 0.0):
+    def __init__(self, flush_interval: float = 0.0, on_launch=None):
         self._flush_interval = flush_interval
         self._queue: list[_Pending] = []
+        self._on_launch = on_launch  # fn(self), called after every launch
         # batching-efficacy counters (asserted in tests, exported to
         # /metrics by app wiring)
         self.launches = 0
@@ -79,6 +80,8 @@ class BatchVerifier:
         self.launches += 1
         self.entries_total += len(flat)
         self.max_batch = max(self.max_batch, len(flat))
+        if self._on_launch is not None:
+            self._on_launch(self)
         pos = 0
         for item in batch:
             n = len(item.entries)

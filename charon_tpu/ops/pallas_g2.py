@@ -54,6 +54,14 @@ SUBLANES = 8
 # Set by tests to run kernels in pallas interpret mode (CPU validation).
 INTERPRET = False
 
+# Set by tests to bypass pallas_call and run the SAME kernel-body functions
+# (_g2_double/_g2_add/_signed_sel/...) as plain jnp over the whole tiled
+# array.  Interpret mode costs ~200 s per kernel launch on CPU (per-op
+# Python dispatch), so the fast differential lane covers the kernel MATH
+# through this switch and the slow lane covers the pallas plumbing
+# (block specs, grid, VMEM) in interpret mode.
+DIRECT = False
+
 
 # ---------------------------------------------------------------------------
 # Host-side constants
@@ -353,20 +361,42 @@ def _get(name: str, s: int):
     return _calls(s // SUBLANES, INTERPRET)[name]
 
 
+def _fc_direct(fc):
+    """DIRECT mode: the fold constants are lane/sublane-invariant, so
+    collapse the broadcast [36, 32, 8, 128] to [36, 32, 1, 1] and let jnp
+    broadcasting fit any tile height S (pallas blocks are always S=8)."""
+    return fc[:, :, :1, :1]
+
+
 def dbl(fc, p):
     """[6, 32, S, 128] tiled G2 points → doubled points."""
+    if DIRECT:
+        return _g2_double(_fc_direct(fc), p)
     return _get("dbl", p.shape[2])(fc, p)
 
 
 def add(fc, a, b):
+    if DIRECT:
+        return _g2_add(_fc_direct(fc), a, b)
     return _get("add", a.shape[2])(fc, a, b)
 
 
 def addsel(fc, acc, p1, p2, p3, w):
+    if DIRECT:
+        fc = _fc_direct(fc)
+        wb = w[None, None, :, :]
+        added = _g2_add(fc, acc, _sel(wb, p1, p2, p3))
+        return jnp.where(wb == 0, acc, added)
     return _get("addsel", acc.shape[2])(fc, acc, p1, p2, p3, w)
 
 
 def dblsel(fc, acc, p1, p2, p3, w):
+    if DIRECT:
+        fc = _fc_direct(fc)
+        acc4 = _g2_double(fc, _g2_double(fc, acc))
+        wb = w[None, None, :, :]
+        added = _g2_add(fc, acc4, _sel(wb, p1, p2, p3))
+        return jnp.where(wb == 0, acc4, added)
     return _get("dblsel", acc.shape[2])(fc, acc, p1, p2, p3, w)
 
 
@@ -447,3 +477,182 @@ def msm_combine(fc, pts_t, windows, t_count: int):
     """Full Lagrange-combine MSM: per-row scalar mul then T-axis tree sum.
     Returns [6, 32, Sv, 128] tiled combined points (Sv = S / t_count)."""
     return tree_sum_t(fc, msm_rows(fc, pts_t, windows), t_count)
+
+
+# ---------------------------------------------------------------------------
+# Straus joint-T MSM with signed 3-bit windows — the round-5 combine path.
+#
+# The per-row MSM above pays 2 doublings + 1 addition per 2 scalar bits for
+# EVERY (validator, share) row: at T shares that is T doubling chains per
+# validator.  Straus interleaving keeps ONE accumulator per validator and
+# shares its doubling chain across all T points:
+#
+#     acc ← 8·acc + Σ_t d_{t,i}·P_t      per 3-bit window i (MSB-first)
+#
+# so a T=7 combine costs 86·(3 dbl + 7 add) = 9,288 Fp2-products per
+# validator instead of 7·128·(2 dbl + 1 add) = 25,088 — 2.7× fewer.  The
+# T-axis tree sum disappears (folded into the joint accumulation).
+#
+# Windows are BALANCED base-8 digits d ∈ [−4, 3]: the table per point is
+# only {P, 2P, 3P, 4P} and negative digits negate Y in-kernel (negation is
+# 2 cheap spread-subtractions — reference CPU combine has no analogue of
+# any of this; it interpolates per validator: tbls/tss.go:142-149).
+# Each iteration launches 1 fused dbl³+add kernel (t = 0) plus T−1 add
+# kernels (t > 0): VMEM holds one 4-entry table + acc double-buffered
+# (~9.4 MB), under the 16 MB budget that forbids a single 7-table kernel.
+# ---------------------------------------------------------------------------
+
+def signed_digit_rows(bits: np.ndarray) -> np.ndarray:
+    """Host: [R, nbits] scalar bit planes (MSB first) → [R, nwin] balanced
+    base-8 digits in [−4, 3], MSB-first per row.  Value-exact:
+    Σᵢ d_{nwin−1−i}·8^i == the scalar (so zero scalars stay all-zero)."""
+    r, nbits = bits.shape
+    # unsigned 3-bit digits, LSB-first: pad bit length to a multiple of 3
+    pad = (-nbits) % 3
+    b = np.concatenate([np.zeros((r, pad), bits.dtype), bits], axis=1)
+    nd = b.shape[1] // 3
+    u = (b[:, ::-1][:, 0::3] * 1 + b[:, ::-1][:, 1::3] * 2
+         + b[:, ::-1][:, 2::3] * 4)                     # [R, nd] LSB-first
+    d = np.zeros((r, nd + 1), np.int32)
+    carry = np.zeros(r, np.int32)
+    for i in range(nd):
+        v = u[:, i] + carry
+        hi = v >= 4
+        d[:, i] = np.where(hi, v - 8, v)
+        carry = hi.astype(np.int32)
+    d[:, nd] = carry
+    return np.ascontiguousarray(d[:, ::-1])             # MSB-first
+
+
+def signed_digits_from_bits(bits: np.ndarray) -> np.ndarray:
+    """Host: [R, nbits] scalar bit planes (MSB first) → [nwin, S, 128]
+    balanced base-8 digits, iteration-major (R = S·128)."""
+    r = bits.shape[0]
+    assert r % LANES == 0
+    d = signed_digit_rows(bits)
+    return np.ascontiguousarray(
+        d.T.reshape(d.shape[1], r // LANES, LANES).astype(np.int32))
+
+
+def _neg_y_where(fc, p, cond):
+    """Negate the Y planes (2, 3) of a stacked point where cond holds.
+    `cond` is [1, 1, rows, 128] (the broadcast window plane)."""
+    c = cond[0, 0]                                  # [rows, 128]
+    y0, y1 = _negf(fc, p[2]), _negf(fc, p[3])
+    return jnp.concatenate([
+        p[0][None], p[1][None],
+        jnp.where(c, y0, p[2])[None], jnp.where(c, y1, p[3])[None],
+        p[4][None], p[5][None]], axis=0)
+
+
+def _signed_sel(fc, w, t1_ref, t2_ref, t3_ref, t4_ref):
+    wa = jnp.abs(w)
+    pt = jnp.where(wa == 1, t1_ref[...],
+                   jnp.where(wa == 2, t2_ref[...],
+                             jnp.where(wa == 3, t3_ref[...], t4_ref[...])))
+    return _neg_y_where(fc, pt, w < 0)
+
+
+def _addsel_s_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, t4_ref,
+                     w_ref, o_ref):
+    """acc ← acc ± table[|w|] for w ∈ [−4, 4]; w = 0 keeps acc."""
+    fc = fc_ref[...]
+    w = w_ref[...][None, None, :, :]
+    added = _g2_add(fc, acc_ref[...],
+                    _signed_sel(fc, w, t1_ref, t2_ref, t3_ref, t4_ref))
+    o_ref[...] = jnp.where(w == 0, acc_ref[...], added)
+
+
+def _dbl3sel_s_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, t4_ref,
+                      w_ref, o_ref):
+    """One fused head step of a 3-bit window: acc ← 8·acc (± table[|w|])."""
+    fc = fc_ref[...]
+    acc8 = _g2_double(fc, _g2_double(fc, _g2_double(fc, acc_ref[...])))
+    w = w_ref[...][None, None, :, :]
+    added = _g2_add(fc, acc8,
+                    _signed_sel(fc, w, t1_ref, t2_ref, t3_ref, t4_ref))
+    o_ref[...] = jnp.where(w == 0, acc8, added)
+
+
+@functools.lru_cache(maxsize=8)
+def _straus_calls(s_blocks: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def pt_spec():
+        return pl.BlockSpec((6, NL, SUBLANES, LANES), lambda i: (0, 0, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    fc_spec = pl.BlockSpec((_FC_ROWS, NL, SUBLANES, LANES),
+                           lambda i: (0, 0, 0, 0), memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+
+    def build(kernel):
+        shape = (6, NL, s_blocks * SUBLANES, LANES)
+        return pl.pallas_call(
+            kernel,
+            grid=(s_blocks,),
+            in_specs=[fc_spec] + [pt_spec() for _ in range(5)] + [w_spec],
+            out_specs=pt_spec(),
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+            interpret=interpret,
+        )
+
+    return {"addsel_s": build(_addsel_s_kernel),
+            "dbl3sel_s": build(_dbl3sel_s_kernel)}
+
+
+def _sget(name: str, s: int):
+    assert s % SUBLANES == 0
+    return _straus_calls(s // SUBLANES, INTERPRET)[name]
+
+
+def addsel_s(fc, acc, t1, t2, t3, t4, w):
+    if DIRECT:
+        fc = _fc_direct(fc)
+        wb = w[None, None, :, :]
+        added = _g2_add(fc, acc, _signed_sel(fc, wb, t1, t2, t3, t4))
+        return jnp.where(wb == 0, acc, added)
+    return _sget("addsel_s", acc.shape[2])(fc, acc, t1, t2, t3, t4, w)
+
+
+def dbl3sel_s(fc, acc, t1, t2, t3, t4, w):
+    if DIRECT:
+        fc = _fc_direct(fc)
+        acc8 = _g2_double(fc, _g2_double(fc, _g2_double(fc, acc)))
+        wb = w[None, None, :, :]
+        added = _g2_add(fc, acc8, _signed_sel(fc, wb, t1, t2, t3, t4))
+        return jnp.where(wb == 0, acc8, added)
+    return _sget("dbl3sel_s", acc.shape[2])(fc, acc, t1, t2, t3, t4, w)
+
+
+def straus_combine(fc, pts_t, digits, t_count: int):
+    """Joint-T Straus MSM over a t-major tiled batch.
+
+    pts_t  [6, 32, S, 128]  t-major rows (row = t·Vpad + v),
+    digits [nwin, S, 128]   balanced base-8 digits, iteration-major,
+    → [6, 32, Sv, 128] combined points (Sv = S / t_count)."""
+    s = pts_t.shape[2]
+    assert s % t_count == 0
+    sv = s // t_count
+    # window tables over ALL rows at once: {P, 2P, 3P, 4P}
+    p2 = dbl(fc, pts_t)
+    p3 = add(fc, p2, pts_t)
+    p4 = dbl(fc, p2)
+    # per-t slices materialised once, outside the window loop
+    tables = [tuple(tbl[:, :, k * sv:(k + 1) * sv, :]
+                    for tbl in (pts_t, p2, p3, p4))
+              for k in range(t_count)]
+    digits_t = [digits[:, k * sv:(k + 1) * sv, :] for k in range(t_count)]
+    nwin = digits.shape[0]
+
+    def body(i, acc):
+        w0 = lax.dynamic_index_in_dim(digits_t[0], i, 0, keepdims=False)
+        acc = dbl3sel_s(fc, acc, *tables[0], w0)
+        for k in range(1, t_count):
+            wk = lax.dynamic_index_in_dim(digits_t[k], i, 0, keepdims=False)
+            acc = addsel_s(fc, acc, *tables[k], wk)
+        return acc
+
+    return lax.fori_loop(0, nwin, body, inf_tiled(sv))

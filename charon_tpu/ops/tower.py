@@ -7,8 +7,9 @@ internally, reference: tbls/tss.go:21-23):
     Fp6  = Fp2[v]/(v³ − ξ), ξ = u + 1   [..., 3, 2, 32]
     Fp12 = Fp6[w]/(w² − v)              [..., 2, 3, 2, 32]
 
-All elements are in Montgomery form; every op is vectorised over arbitrary
-leading batch dims (the validator-batch axis of the sigagg kernels).  The
+All elements are plain redundant residues (ops/fp.py; the former
+Montgomery representation was dropped in commit d77bd22 — R_MONT == 1);
+every op is vectorised over arbitrary leading batch dims (the validator-batch axis of the sigagg kernels).  The
 single-variable oracle tower (charon_tpu.tbls.ref.fields.FQ12, modulus
 w¹² − 2w⁶ + 2) is related by w_tower = w_oracle, u = w⁶ − 1; the conversion
 used by the differential tests lives in `f12_to_oracle` / `f12_from_oracle`.
@@ -137,7 +138,7 @@ def f2_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 
 def f2_pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
-    """a^e in Fp2 (Montgomery in/out) for a compile-time exponent — the
+    """a^e in Fp2 (redundant residues in/out) for a compile-time exponent — the
     building block of the device square root (ops/codec.py)."""
     from jax import lax
 
@@ -403,11 +404,11 @@ def f12_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Frobenius (x ↦ x^p) — coefficients precomputed host-side in Montgomery form
+# Frobenius (x ↦ x^p) — coefficients precomputed host-side as limb planes
 # ---------------------------------------------------------------------------
 
 def _fq2_const(x: FQ2) -> np.ndarray:
-    """Oracle FQ2 → Montgomery limb constant [2, 32]."""
+    """Oracle FQ2 → limb-plane constant [2, 32]."""
     c0, c1 = x.coeffs
     return np.stack([fp.to_limbs(c0 * fp.R_MONT % P),
                      fp.to_limbs(c1 * fp.R_MONT % P)])
@@ -444,12 +445,12 @@ F12_ONE_M = np.stack([F6_ONE_M, F6_ZERO])
 
 
 def f2_pack(xs: list[FQ2]) -> np.ndarray:
-    """Oracle FQ2 list → Montgomery [len, 2, 32]."""
+    """Oracle FQ2 list → limb planes [len, 2, 32]."""
     return np.stack([_fq2_const(x) for x in xs])
 
 
 def f2_unpack(arr) -> list[FQ2]:
-    """Montgomery [..., 2, 32] → flat list of oracle FQ2."""
+    """Limb planes [..., 2, 32] → flat list of oracle FQ2."""
     a = np.asarray(arr).reshape(-1, 2, fp.NLIMBS)
     rinv = pow(fp.R_MONT, -1, P)
     return [FQ2([fp.from_limbs(row[0]) * rinv % P,
@@ -457,7 +458,7 @@ def f2_unpack(arr) -> list[FQ2]:
 
 
 def f12_pack(xs: list[FQ12]) -> np.ndarray:
-    """Oracle single-variable FQ12 list → tower Montgomery [len, 2, 3, 2, 32].
+    """Oracle single-variable FQ12 list → tower limb planes [len, 2, 3, 2, 32].
 
     Inverse of the embedding u = w⁶ − 1: tower coefficient b_m = x_m + y_m·u
     at w^m (m = 2j + k) has y_m = c_{m+6}, x_m = c_m + c_{m+6}.
@@ -475,7 +476,7 @@ def f12_pack(xs: list[FQ12]) -> np.ndarray:
 
 
 def f12_unpack(arr) -> list[FQ12]:
-    """Tower Montgomery [..., 2, 3, 2, 32] → flat list of oracle FQ12."""
+    """Tower limb planes [..., 2, 3, 2, 32] → flat list of oracle FQ12."""
     a = np.asarray(arr).reshape(-1, 2, 3, 2, fp.NLIMBS)
     rinv = pow(fp.R_MONT, -1, P)
     out = []

@@ -12,8 +12,8 @@ device kernels:
   (the `core/sigagg` hot call, reference: core/sigagg/sigagg.go:75-77).
 
 Host↔device boundary: points cross as oracle affine tuples (the api layer
-deserialises wire bytes); this module packs them into Montgomery limb
-planes.  Shapes are padded to powers of two so jax.jit recompiles only
+deserialises wire bytes); this module packs them into 12-bit limb
+planes (plain redundant residues, ops/fp.py).  Shapes are padded to powers of two so jax.jit recompiles only
 O(log n) times across workload sizes.
 """
 
@@ -50,6 +50,7 @@ def _pad_pow2(n: int, floor: int = 1) -> int:
 # the modular inverses once per distinct set (reference recomputes per call,
 # tbls/tss.go:142-149).
 _LAG_BITS: dict[tuple[int, ...], np.ndarray] = {}
+_LAG_DIGITS: dict[tuple[int, ...], np.ndarray] = {}
 
 
 def _lagrange_bits(idxs: tuple[int, ...]) -> np.ndarray:
@@ -58,6 +59,15 @@ def _lagrange_bits(idxs: tuple[int, ...]) -> np.ndarray:
         lam = shamir.lagrange_coeffs_at_zero(list(idxs))
         out = jcurve.scalars_to_bits([lam[i] for i in idxs])
         _LAG_BITS[idxs] = out
+    return out
+
+
+def _lagrange_digits(idxs: tuple[int, ...]) -> np.ndarray:
+    """Balanced base-8 digit rows [t, 87] for the Straus combine path."""
+    out = _LAG_DIGITS.get(idxs)
+    if out is None:
+        out = pallas_g2.signed_digit_rows(_lagrange_bits(idxs))
+        _LAG_DIGITS[idxs] = out
     return out
 
 
@@ -92,8 +102,9 @@ def _msm_normalize_kernel(pts, bits):
 
 # -- fused-MSM combine path (ops/pallas_g2): persistent limbs-major tiled
 # layout, one fused kernel launch per 2-bit MSM iteration.  Default on TPU
-# backends; CHARON_TPU_FUSED_MSM=0 opts out (CPU tests exercise the same
-# kernels in pallas interpret mode via tests/test_pallas_g2.py).
+# backends; CHARON_TPU_FUSED_MSM=0 opts out (tests/test_pallas_g2.py exercises the same
+# kernel bodies on CPU: DIRECT mode in the fast lane, pallas interpret
+# mode in the slow lane).
 
 def _use_fused() -> bool:
     flag = os.environ.get("CHARON_TPU_FUSED_MSM", "auto")
@@ -115,6 +126,58 @@ def _msm_fused_normalize_kernel(pts, windows, t_count):
     tiled = pallas_g2.tile_points(pts)
     out = pallas_g2.msm_combine(fc, tiled, windows, t_count)
     return codec.g2_normalize(pallas_g2.untile_points(out))
+
+
+@functools.partial(jax.jit, static_argnames=("t_count",))
+def _msm_straus_normalize_kernel(pts, digits, t_count):
+    """Straus joint-T combine (ops/pallas_g2.straus_combine): pts
+    [T·Vpad, 3, 2, 32] t-major, digits [87, S, 128] balanced base-8 →
+    normalized std-form affine planes of the Vpad combined points."""
+    fc = jnp.asarray(pallas_g2.fold_consts())
+    tiled = pallas_g2.tile_points(pts)
+    out = pallas_g2.straus_combine(fc, tiled, digits, t_count)
+    return codec.g2_normalize(pallas_g2.untile_points(out))
+
+
+def _msm_kind() -> str:
+    """CHARON_TPU_MSM: straus (default) | dblsel (the round-4 per-row
+    2-bit path, kept for A/B benchmarking)."""
+    return os.environ.get("CHARON_TPU_MSM", "straus")
+
+
+def straus_combine_sharded(mesh, pts_vt, digits_vt):
+    """Multi-chip fused combine: shard the validator batch (the framework's
+    data-parallel axis, SURVEY.md §2.9) over `mesh`'s "dp" axis and run the
+    fused Straus kernels independently per device — validators are
+    independent, so no collectives cross the ICI for the MSM itself.
+
+    pts_vt    [V, T, 3, 2, 32]  per-validator share points,
+    digits_vt [V, T, nwin]      balanced base-8 Lagrange digits,
+    → [V, 3, 2, 32] combined group-signature points, dp-sharded.
+
+    Each device transposes its local batch to the t-major tiled row layout
+    (local rows = T·V_local must be a multiple of 1024) and runs the same
+    `pallas_g2.straus_combine` the single-chip bytes path uses.  This is
+    the sharding shape `__graft_entry__.dryrun_multichip` and
+    tests/test_sharding.py validate on the 8-virtual-device CPU mesh."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    v, t, _, _, nl = pts_vt.shape
+    nwin = digits_vt.shape[2]
+
+    def local(p, d):
+        vl = p.shape[0]
+        rows = p.transpose(1, 0, 2, 3, 4).reshape(vl * t, 3, 2, nl)
+        digits = d.transpose(2, 1, 0).reshape(nwin, (t * vl) // 128, 128)
+        fc = jnp.asarray(pallas_g2.fold_consts())
+        out = pallas_g2.straus_combine(fc, pallas_g2.tile_points(rows),
+                                       digits, t)
+        return pallas_g2.untile_points(out)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P("dp"), P("dp")), out_specs=P("dp"))
+    return jax.jit(fn)(pts_vt, digits_vt)
 
 
 @jax.jit
@@ -255,8 +318,10 @@ class TPUBackend:
         nv = len(batch)
         vpad = max(1024, -(-nv // 1024) * 1024)
         t = max(len(sigs) for sigs in batch)
+        straus = _msm_kind() == "straus"
+        nwin = 87 if straus else jcurve.SCALAR_BITS
         raw = np.broadcast_to(_G2_INF_BYTES, (t, vpad, 96)).copy()
-        bits = np.zeros((t, vpad, jcurve.SCALAR_BITS), np.int32)
+        scal = np.zeros((t, vpad, nwin), np.int32)
         counts = np.zeros(vpad, np.int32)
         for col, sigs in enumerate(batch):
             idxs = tuple(sigs)
@@ -265,7 +330,8 @@ class TPUBackend:
             sig_bytes = b"".join(sigs[i] for i in idxs)
             raw[: len(idxs), col] = np.frombuffer(
                 sig_bytes, np.uint8).reshape(len(idxs), 96)
-            bits[: len(idxs), col] = _lagrange_bits(idxs)
+            scal[: len(idxs), col] = (_lagrange_digits(idxs) if straus
+                                      else _lagrange_bits(idxs))
             counts[col] = len(idxs)
         xc0, xc1, sign, inf, bad = codec.g2_bytes_split(raw.reshape(-1, 96))
         real = (np.arange(t)[:, None] < counts[None, :]).reshape(-1)
@@ -275,10 +341,17 @@ class TPUBackend:
         pts, ok = _decompress_kernel(
             jnp.asarray(xc0.reshape(shape)), jnp.asarray(xc1.reshape(shape)),
             jnp.asarray(sign.reshape(-1)), jnp.asarray(inf.reshape(-1)))
-        windows = pallas_g2.windows_from_bits(
-            bits.reshape(-1, jcurve.SCALAR_BITS))
-        oxc0, oxc1, oyc0, oyc1, oinf = _msm_fused_normalize_kernel(
-            pts, jnp.asarray(windows), t)
+        if straus:
+            # [t, vpad, 87] → iteration-major [87, S, 128] t-major rows
+            digits = np.ascontiguousarray(
+                scal.reshape(t * vpad, nwin).T.reshape(
+                    nwin, t * vpad // 128, 128))
+            oxc0, oxc1, oyc0, oyc1, oinf = _msm_straus_normalize_kernel(
+                pts, jnp.asarray(digits), t)
+        else:
+            windows = pallas_g2.windows_from_bits(scal.reshape(-1, nwin))
+            oxc0, oxc1, oyc0, oyc1, oinf = _msm_fused_normalize_kernel(
+                pts, jnp.asarray(windows), t)
         if not (np.asarray(ok) | ~real).all():
             raise ValueError("signature bytes not on the G2 curve")
         out = codec.g2_compress_np(np.asarray(oxc0), np.asarray(oxc1),

@@ -158,6 +158,16 @@ def test_cli_create_cluster_and_run(tmp_path):
             infosync_ok = any(a.infosync._results for a in apps)
             assert infosync_ok, "infosync never reached agreement"
 
+            # --- BatchVerifier wiring: the SAME verifier serves the vapi
+            #     and the inbound parsigex hook, and it actually launched
+            #     (round-4 dead-code finding; reference per-sig call-sites:
+            #     validatorapi.go:1052-1068, parsigex.go:152-176) ---
+            for a in apps:
+                assert a.vapi._verifier is a.verifier
+            assert any(a.verifier.launches > 0 for a in apps), \
+                "BatchVerifier never launched"
+            assert "core_verify_launches_total" in metrics
+
             # --- cross-cluster duty trace: same deterministic trace ID
             #     joins spans from MULTIPLE nodes (core/tracing.go:34-51) ---
             from charon_tpu.app.tracing import duty_trace_id

@@ -1,0 +1,199 @@
+"""BatchVerifier: tick-coalesced partial-signature verification.
+
+The reference verifies partial signatures one at a time at two call-sites
+(core/validatorapi/validatorapi.go:1052-1068 local-VC submissions;
+core/parsigex/parsigex.go:152-176 inbound peer exchange).  The TPU build
+routes BOTH through one shared BatchVerifier so concurrent verifications
+coalesce into a single `tbls.batch_verify` device launch per event-loop
+tick.  These tests assert the coalescing contract (N concurrent calls →
+1 launch), verdict ordering, error propagation, and the Node/App wiring.
+"""
+
+import asyncio
+from dataclasses import dataclass
+
+import pytest
+
+from charon_tpu.core.types import Duty, DutyType, ParSignedData
+from charon_tpu.core.verify import BatchVerifier
+from charon_tpu.eth2util.signing import DomainName, signing_root
+from charon_tpu.tbls import api as tbls
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+@pytest.fixture
+def counted_batch_verify(monkeypatch):
+    """Wrap tbls.batch_verify with a launch counter (the BatchVerifier
+    counters count its own launches; this asserts no OTHER path sneaks a
+    per-entry tbls.verify in)."""
+    calls = []
+    orig = tbls.batch_verify
+
+    def counting(entries):
+        calls.append(len(entries))
+        return orig(entries)
+
+    monkeypatch.setattr(tbls, "batch_verify", counting)
+    return calls
+
+
+def _keypair(tag: bytes):
+    sk = tag.ljust(32, b"\0")
+    return sk, tbls.privkey_to_pubkey(sk)
+
+
+def test_concurrent_verifies_coalesce_into_one_launch(counted_batch_verify):
+    """N concurrent verify() calls on one tick → exactly ONE launch."""
+    v = BatchVerifier()
+    n = 16
+    pairs = [_keypair(bytes([i + 1])) for i in range(n)]
+    msgs = [bytes([i]) * 32 for i in range(n)]
+
+    async def main():
+        return await asyncio.gather(*[
+            v.verify(pk, msgs[i], tbls.sign(sk, msgs[i]))
+            for i, (sk, pk) in enumerate(pairs)])
+
+    oks = asyncio.run(main())
+    assert oks == [True] * n
+    assert v.launches == 1
+    assert v.entries_total == n
+    assert v.max_batch == n
+    assert counted_batch_verify == [n]
+
+
+def test_verify_many_orders_and_flags_invalid(counted_batch_verify):
+    """A message's entries verify as one unit; verdicts keep entry order."""
+    v = BatchVerifier()
+    sk1, pk1 = _keypair(b"\x01")
+    sk2, pk2 = _keypair(b"\x02")
+    good1 = tbls.sign(sk1, b"m1")
+    good2 = tbls.sign(sk2, b"m2")
+    bad = tbls.sign(sk1, b"other")
+    entries = [(pk1, b"m1", good1), (pk2, b"m2", bad), (pk2, b"m2", good2)]
+
+    oks = asyncio.run(v.verify_many(entries))
+    assert oks == [True, False, True]
+    assert v.launches == 1 and v.max_batch == 3
+    assert counted_batch_verify == [3]
+
+
+def test_cross_message_coalescing(counted_batch_verify):
+    """Several verify_many units landing on one tick share a launch and
+    each unit still gets its own verdict slice."""
+    v = BatchVerifier()
+    sk, pk = _keypair(b"\x07")
+
+    async def main():
+        u1 = v.verify_many([(pk, b"a", tbls.sign(sk, b"a")),
+                            (pk, b"b", tbls.sign(sk, b"b"))])
+        u2 = v.verify_many([(pk, b"c", tbls.sign(sk, b"wrong"))])
+        u3 = v.verify_many([(pk, b"d", tbls.sign(sk, b"d"))])
+        return await asyncio.gather(u1, u2, u3)
+
+    r1, r2, r3 = asyncio.run(main())
+    assert r1 == [True, True] and r2 == [False] and r3 == [True]
+    assert v.launches == 1
+    assert v.max_batch == 4
+    assert counted_batch_verify == [4]
+
+
+def test_launch_failure_propagates(monkeypatch):
+    def boom(entries):
+        raise RuntimeError("device fault")
+
+    monkeypatch.setattr(tbls, "batch_verify", boom)
+    v = BatchVerifier()
+    with pytest.raises(RuntimeError, match="device fault"):
+        asyncio.run(v.verify(b"pk", b"msg", b"sig"))
+
+
+def test_empty_verify_many_is_free():
+    v = BatchVerifier()
+    assert asyncio.run(v.verify_many([])) == []
+    assert v.launches == 0
+
+
+def test_on_launch_hook_fires():
+    seen = []
+    v = BatchVerifier(on_launch=lambda bv: seen.append(
+        (bv.launches, bv.entries_total)))
+    sk, pk = _keypair(b"\x05")
+    asyncio.run(v.verify(pk, b"m", tbls.sign(sk, b"m")))
+    assert seen == [(1, 1)]
+
+
+# ---------------------------------------------------------------------------
+# Wiring: Node routes both verify call-sites through ONE shared verifier
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _FakeSigned:
+    """Duck-typed SignedData carrying a precomputed attester root."""
+
+    root: bytes
+    signature: bytes
+
+    def signing_info(self, spe):
+        return DomainName.BEACON_ATTESTER, 0
+
+    def message_root(self):
+        return self.root
+
+
+def _make_node(cluster):
+    from charon_tpu.app.node import Node, NodeConfig
+    from charon_tpu.core.leadercast import LeaderCast, MemTransportNetwork
+    from charon_tpu.core.parsigex import MemParSigExNetwork
+    from charon_tpu.testutil.beaconmock import BeaconMock
+
+    pubshares_by_peer = {
+        idx: cluster.pubshare_map(idx)
+        for idx in range(1, cluster.num_nodes + 1)}
+    bmock = BeaconMock(slot_duration=1.0, slots_per_epoch=4)
+    cfg = NodeConfig(share_idx=1, threshold=cluster.threshold,
+                     pubshares_by_peer=pubshares_by_peer)
+    return Node(cfg, bmock,
+                consensus=LeaderCast(MemTransportNetwork(), 0, 1),
+                parsigex=MemParSigExNetwork().join())
+
+
+def test_node_wires_shared_verifier(counted_batch_verify):
+    from charon_tpu.testutil.cluster import new_cluster_for_test
+
+    cluster = new_cluster_for_test(2, 3, 4)
+    node = _make_node(cluster)
+
+    # the SAME BatchVerifier serves the vapi and the parsigex inbound hook
+    assert node.vapi._verifier is node.verifier
+
+    # inbound peer message with partials for ALL validators → one unit,
+    # one launch (reference loops tbls.verify per sig: parsigex.go:152-176)
+    fork, gvr = node.cfg.fork_version, node.cfg.genesis_validators_root
+    duty = Duty(3, DutyType.ATTESTER)
+    pset = {}
+    for k, val in enumerate(cluster.validators):
+        root = bytes([k]) * 32
+        sroot = signing_root(DomainName.BEACON_ATTESTER, root, fork, gvr)
+        sig = tbls.sign(val.share_privkeys[2], sroot)
+        pset[val.group_pubkey] = ParSignedData(
+            data=_FakeSigned(root=root, signature=sig), share_idx=2)
+
+    asyncio.run(node._verify_external(duty, pset))
+    assert node.verifier.launches == 1
+    assert node.verifier.max_batch == len(cluster.validators)
+    assert counted_batch_verify == [len(cluster.validators)]
+
+    # a bad partial in the message rejects the whole unit
+    bad_val = cluster.validators[0]
+    bad_sig = tbls.sign(bad_val.share_privkeys[2], b"\xff" * 32)
+    bad_pset = {bad_val.group_pubkey: ParSignedData(
+        data=_FakeSigned(root=b"\x01" * 32, signature=bad_sig), share_idx=2)}
+    with pytest.raises(ValueError, match="invalid external partial"):
+        asyncio.run(node._verify_external(duty, bad_pset))

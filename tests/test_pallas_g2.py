@@ -1,0 +1,348 @@
+"""Differential tests for the fused Pallas G2 kernels (ops/pallas_g2).
+
+This is the production TPU combine path (`tbls/backend_tpu._combine_bytes_
+fused`, default-on for TPU backends — the core/sigagg hot call, reference:
+tbls/tss.go:142-149 via core/sigagg/sigagg.go:75-77).  Coverage is split
+by cost:
+
+- FAST lane (default): DIRECT mode runs the exact kernel-body functions
+  (_g2_double/_g2_add/_signed_sel/...) as plain jnp over the tiled arrays
+  against the ops/curve.py complete-group-law oracle, point-for-point via
+  eq_points — kernel math, window drivers (msm_combine, straus_combine),
+  digit recoding, and the bytes-in/bytes-out fused combine.
+- SLOW lane: the same kernels through the real pl.pallas_call in interpret
+  mode (block specs, grid, VMEM plumbing) — ~200 s per launch on CPU —
+  asserted equal to the DIRECT outputs.  On hardware, bench.py's per-rep
+  oracle checks validate the compiled kernels themselves.
+
+Row sets include the complete-formula edge cases: infinity operands,
+P + P (doubling through the addition formula), P + (−P), zero windows,
+and negative signed digits.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from charon_tpu.ops import curve as jcurve
+from charon_tpu.ops import pallas_g2
+from charon_tpu.ops.curve import F2_OPS
+from charon_tpu.tbls.ref import curve as refcurve
+
+R = 1024  # minimum tiled batch: SUBLANES * LANES rows
+
+
+@pytest.fixture(autouse=True)
+def direct_mode():
+    pallas_g2.DIRECT = True
+    yield
+    pallas_g2.DIRECT = False
+
+
+def _fc():
+    return jnp.asarray(pallas_g2.fold_consts())
+
+
+def _ref_points(n: int, seed: int = 7) -> list:
+    """n distinct G2 points (random multiples of the generator) with None
+    rows (infinity) sprinkled in."""
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(1, 2**30, size=n)
+    pts = [refcurve.multiply(refcurve.G2_GEN, int(k)) for k in ks]
+    for i in range(0, n, 9):
+        pts[i] = None  # infinity rows
+    return pts
+
+
+def _packed(n_distinct: int, seed: int = 7, rows: int = R) -> np.ndarray:
+    """[rows, 3, 2, 32] packed rows cycling through n_distinct points."""
+    base = jcurve.g2_pack(_ref_points(n_distinct, seed))
+    reps = -(-rows // n_distinct)
+    return np.tile(base, (reps, 1, 1, 1))[:rows]
+
+
+def _tiled(packed: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(pallas_g2.tile_points(packed))
+
+
+def _assert_same(tiled_out, oracle_pts):
+    got = pallas_g2.untile_points(tiled_out)
+    eq = jcurve.eq_points(F2_OPS, got, oracle_pts)
+    assert bool(np.asarray(eq).all()), \
+        f"{int((~np.asarray(eq)).sum())} rows diverge from the oracle"
+
+
+def test_dbl_matches_oracle():
+    pts = _packed(16)
+    out = pallas_g2.dbl(_fc(), _tiled(pts))
+    _assert_same(out, jcurve.double_point(F2_OPS, jnp.asarray(pts)))
+
+
+def test_add_matches_oracle_including_edge_cases():
+    a = _packed(16, seed=1)
+    b = _packed(16, seed=2)
+    # force the complete-formula edge cases onto specific rows:
+    b[0] = a[0]                                     # P + P (doubling)
+    neg = np.asarray(jcurve.neg_point(F2_OPS, jnp.asarray(a[1:2])))[0]
+    b[1] = neg                                      # P + (−P) = ∞
+    inf = jcurve.g2_pack([None])[0]
+    b[2] = inf                                      # P + ∞
+    a[3] = inf                                      # ∞ + Q
+    out = pallas_g2.add(_fc(), _tiled(a), _tiled(b))
+    _assert_same(out, jcurve.add_points(F2_OPS, jnp.asarray(a),
+                                        jnp.asarray(b)))
+
+
+def _window_table(pts, four=False):
+    """(P, 2P, 3P[, 4P]) multiples for the select kernels."""
+    jp = jnp.asarray(pts)
+    p2 = jcurve.double_point(F2_OPS, jp)
+    p3 = jcurve.add_points(F2_OPS, p2, jp)
+    if not four:
+        return jp, p2, p3
+    return jp, p2, p3, jcurve.double_point(F2_OPS, p2)
+
+
+def _oracle_select(w, p1, p2, p3):
+    inf = jcurve.inf_point(F2_OPS, (R,))
+    return jcurve.point_select(
+        F2_OPS, w == 1, p1,
+        jcurve.point_select(F2_OPS, w == 2, p2,
+                            jcurve.point_select(F2_OPS, w == 3, p3, inf)))
+
+
+def test_addsel_matches_oracle():
+    pts = _packed(16, seed=3)
+    acc = _packed(16, seed=4)
+    p1, p2, p3 = _window_table(pts)
+    w = np.random.default_rng(5).integers(0, 4, size=R).astype(np.int32)
+
+    out = pallas_g2.addsel(_fc(), _tiled(acc),
+                           _tiled(np.asarray(p1)), _tiled(np.asarray(p2)),
+                           _tiled(np.asarray(p3)),
+                           jnp.asarray(w.reshape(R // 128, 128)))
+    jacc = jnp.asarray(acc)
+    jw = jnp.asarray(w)
+    added = jcurve.add_points(F2_OPS, jacc, _oracle_select(jw, p1, p2, p3))
+    oracle = jcurve.point_select(F2_OPS, jw == 0, jacc, added)
+    _assert_same(out, oracle)
+
+
+def test_dblsel_matches_oracle():
+    """One fused 2-bit MSM iteration: acc ← 4·acc (+ table[w])."""
+    pts = _packed(16, seed=6)
+    acc = _packed(16, seed=7)
+    p1, p2, p3 = _window_table(pts)
+    w = np.random.default_rng(8).integers(0, 4, size=R).astype(np.int32)
+
+    out = pallas_g2.dblsel(_fc(), _tiled(acc),
+                           _tiled(np.asarray(p1)), _tiled(np.asarray(p2)),
+                           _tiled(np.asarray(p3)),
+                           jnp.asarray(w.reshape(R // 128, 128)))
+    jacc = jnp.asarray(acc)
+    jw = jnp.asarray(w)
+    acc4 = jcurve.double_point(F2_OPS, jcurve.double_point(F2_OPS, jacc))
+    added = jcurve.add_points(F2_OPS, acc4, _oracle_select(jw, p1, p2, p3))
+    oracle = jcurve.point_select(F2_OPS, jw == 0, acc4, added)
+    _assert_same(out, oracle)
+
+
+# ---------------------------------------------------------------------------
+# Straus signed-window kernels (the round-5 combine path)
+# ---------------------------------------------------------------------------
+
+def _oracle_signed(w, p1, p2, p3, p4):
+    """acc-addend for a balanced digit w ∈ [−4, 4] (0 → ∞)."""
+    wa = jnp.abs(w)
+    inf = jcurve.inf_point(F2_OPS, (R,))
+    pt = jcurve.point_select(
+        F2_OPS, wa == 1, p1,
+        jcurve.point_select(F2_OPS, wa == 2, p2,
+                            jcurve.point_select(F2_OPS, wa == 3, p3,
+                                                jcurve.point_select(
+                                                    F2_OPS, wa == 4, p4,
+                                                    inf))))
+    return jcurve.point_select(F2_OPS, w < 0,
+                               jcurve.neg_point(F2_OPS, pt), pt)
+
+
+def test_addsel_signed_matches_oracle():
+    pts = _packed(16, seed=9)
+    acc = _packed(16, seed=10)
+    p1, p2, p3, p4 = _window_table(pts, four=True)
+    w = np.random.default_rng(11).integers(-4, 4, size=R).astype(np.int32)
+
+    out = pallas_g2.addsel_s(
+        _fc(), _tiled(acc), _tiled(np.asarray(p1)), _tiled(np.asarray(p2)),
+        _tiled(np.asarray(p3)), _tiled(np.asarray(p4)),
+        jnp.asarray(w.reshape(R // 128, 128)))
+    jacc, jw = jnp.asarray(acc), jnp.asarray(w)
+    added = jcurve.add_points(F2_OPS, jacc,
+                              _oracle_signed(jw, p1, p2, p3, p4))
+    oracle = jcurve.point_select(F2_OPS, jw == 0, jacc, added)
+    _assert_same(out, oracle)
+
+
+def test_dbl3sel_signed_matches_oracle():
+    """One fused 3-bit Straus iteration head: acc ← 8·acc (± table[|w|])."""
+    pts = _packed(16, seed=12)
+    acc = _packed(16, seed=13)
+    p1, p2, p3, p4 = _window_table(pts, four=True)
+    w = np.random.default_rng(14).integers(-4, 4, size=R).astype(np.int32)
+
+    out = pallas_g2.dbl3sel_s(
+        _fc(), _tiled(acc), _tiled(np.asarray(p1)), _tiled(np.asarray(p2)),
+        _tiled(np.asarray(p3)), _tiled(np.asarray(p4)),
+        jnp.asarray(w.reshape(R // 128, 128)))
+    jacc, jw = jnp.asarray(acc), jnp.asarray(w)
+    acc8 = jcurve.double_point(
+        F2_OPS, jcurve.double_point(F2_OPS,
+                                    jcurve.double_point(F2_OPS, jacc)))
+    added = jcurve.add_points(F2_OPS, acc8,
+                              _oracle_signed(jw, p1, p2, p3, p4))
+    oracle = jcurve.point_select(F2_OPS, jw == 0, acc8, added)
+    _assert_same(out, oracle)
+
+
+def test_signed_digit_rows_value_exact():
+    """Balanced base-8 recoding: Σ dᵢ·8^i reconstructs the scalar exactly,
+    digits stay in [−4, 3], zero scalars stay all-zero."""
+    rng = np.random.default_rng(15)
+    scalars = [0, 1, 7, 2**255 - 19, jcurve.R - 1] + \
+        [int(rng.integers(0, 2**63)) ** 4 % jcurve.R for _ in range(123)]
+    bits = jcurve.scalars_to_bits(scalars)
+    d = pallas_g2.signed_digit_rows(bits)
+    assert d.min() >= -4 and d.max() <= 3
+    nwin = d.shape[1]
+    for row, s in zip(d, scalars):
+        val = 0
+        for dig in row:                       # MSB-first
+            val = val * 8 + int(dig)
+        assert val == s % jcurve.R            # scalars_to_bits reduces mod R
+    assert (d[0] == 0).all()                  # zero scalar → all-zero digits
+
+
+def _short_bits(rng, rows: int, nbits: int) -> np.ndarray:
+    scalars = rng.integers(0, 2**nbits, size=rows)
+    bits = np.zeros((rows, nbits), np.int32)
+    for i, s in enumerate(scalars):
+        bits[i] = [int(b) for b in format(int(s), f"0{nbits}b")]
+    return bits
+
+
+def test_msm_combine_matches_jnp_msm():
+    """The per-row 2-bit MSM driver + T-axis tree sum vs jcurve.msm, with
+    short scalars to bound the loop.  Rows are T-MAJOR (row = t·Vp + v)
+    exactly as _combine_bytes_fused lays them out."""
+    t_count, vp = 2, R // 2
+    nbits = 16
+    pts = _packed(16, seed=16)                      # [R, 3, 2, 32] t-major
+    bits = _short_bits(np.random.default_rng(17), R, nbits)
+
+    windows = pallas_g2.windows_from_bits(bits)
+    out = pallas_g2.msm_combine(_fc(), _tiled(pts), jnp.asarray(windows),
+                                t_count)
+    got = pallas_g2.untile_points(out)              # [vp, 3, 2, 32]
+
+    pts_vt = jnp.asarray(pts.reshape(t_count, vp, 3, 2, 32)
+                         .transpose(1, 0, 2, 3, 4))
+    bits_vt = jnp.asarray(bits.reshape(t_count, vp, nbits)
+                          .transpose(1, 0, 2))
+    oracle = jcurve.msm(F2_OPS, pts_vt, bits_vt, axis=1)
+    eq = jcurve.eq_points(F2_OPS, got, oracle)
+    assert bool(np.asarray(eq).all())
+
+
+def test_straus_combine_matches_jnp_msm():
+    """The joint-T Straus driver (shared doubling chain, signed 3-bit
+    windows) vs jcurve.msm on the same t-major rows."""
+    t_count, vp = 2, R // 2
+    nbits = 18
+    pts = _packed(16, seed=18)
+    bits = _short_bits(np.random.default_rng(19), R, nbits)
+
+    digits = pallas_g2.signed_digits_from_bits(bits)
+    out = pallas_g2.straus_combine(_fc(), _tiled(pts), jnp.asarray(digits),
+                                   t_count)
+    got = pallas_g2.untile_points(out)
+
+    pts_vt = jnp.asarray(pts.reshape(t_count, vp, 3, 2, 32)
+                         .transpose(1, 0, 2, 3, 4))
+    bits_vt = jnp.asarray(bits.reshape(t_count, vp, nbits)
+                          .transpose(1, 0, 2))
+    oracle = jcurve.msm(F2_OPS, pts_vt, bits_vt, axis=1)
+    eq = jcurve.eq_points(F2_OPS, got, oracle)
+    assert bool(np.asarray(eq).all())
+
+
+# ---------------------------------------------------------------------------
+# End-to-end bytes path + pallas plumbing (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("msm_kind", ["straus", "dblsel"])
+def test_combine_bytes_fused_matches_jnp_and_cpu(monkeypatch, msm_kind):
+    """End-to-end `_combine_bytes_fused` (production TPU combine,
+    CHARON_TPU_FUSED_MSM=1, full 255-bit Lagrange scalars) vs the jnp
+    device path (CHARON_TPU_FUSED_MSM=0), bytes-exact, on real Shamir
+    shares — for both the Straus and the legacy per-row MSM drivers."""
+    from charon_tpu.tbls import api as tbls
+    from charon_tpu.tbls.backend_tpu import TPUBackend
+
+    tbls.set_scheme("bls")
+    nv, threshold, n = 3, 3, 4
+    batch = []
+    groups = []
+    for v in range(nv):
+        tss, shares = tbls.generate_tss(threshold, n,
+                                        seed=b"pallas-g2" + bytes([v]))
+        idxs = (1, 2, 4) if v % 2 else (2, 3, 4)
+        batch.append({i: tbls.sign(shares[i], b"duty-root-%d" % v)
+                      for i in idxs})
+        groups.append((tss.group_pubkey, b"duty-root-%d" % v))
+
+    be = TPUBackend()
+    monkeypatch.setenv("CHARON_TPU_MSM", msm_kind)
+    monkeypatch.setenv("CHARON_TPU_FUSED_MSM", "1")
+    fused = be.threshold_combine_bytes(batch)
+    monkeypatch.setenv("CHARON_TPU_FUSED_MSM", "0")
+    jnp_path = be.threshold_combine_bytes(batch)
+
+    assert fused == jnp_path, "fused combine diverges from the jnp path"
+    # and the combined group signatures actually verify (t = threshold)
+    for sig, (gpk, msg) in zip(fused, groups):
+        assert tbls.verify(gpk, msg, sig)
+
+
+@pytest.mark.slow
+def test_pallas_plumbing_interpret_mode():
+    """The real pl.pallas_call pipeline (grid, block specs, fc/w specs) in
+    interpret mode vs DIRECT mode for one unfused and one fused-Straus
+    kernel.  ~200 s per launch on CPU — slow lane only; on hardware the
+    bench's per-rep oracle checks cover the compiled kernels."""
+    fc = _fc()
+    pts = _packed(16, seed=20)
+    acc = _packed(16, seed=21)
+    p1, p2, p3, p4 = _window_table(pts, four=True)
+    w = np.random.default_rng(22).integers(-4, 4, size=R).astype(np.int32)
+    wt = jnp.asarray(w.reshape(R // 128, 128))
+    args = (_tiled(acc), _tiled(np.asarray(p1)), _tiled(np.asarray(p2)),
+            _tiled(np.asarray(p3)), _tiled(np.asarray(p4)), wt)
+
+    pallas_g2.DIRECT = True
+    direct_dbl = pallas_g2.dbl(fc, _tiled(pts))
+    direct_straus = pallas_g2.dbl3sel_s(fc, *args)
+    pallas_g2.DIRECT = False
+    pallas_g2.INTERPRET = True
+    try:
+        interp_dbl = pallas_g2.dbl(fc, _tiled(pts))
+        interp_straus = pallas_g2.dbl3sel_s(fc, *args)
+    finally:
+        pallas_g2.INTERPRET = False
+    assert bool(np.asarray(jcurve.eq_points(
+        F2_OPS, pallas_g2.untile_points(interp_dbl),
+        pallas_g2.untile_points(direct_dbl))).all())
+    assert bool(np.asarray(jcurve.eq_points(
+        F2_OPS, pallas_g2.untile_points(interp_straus),
+        pallas_g2.untile_points(direct_straus))).all())
