@@ -80,11 +80,22 @@ class BatchVerifier:
         self.launches += 1
         self.entries_total += len(flat)
         self.max_batch = max(self.max_batch, len(flat))
-        if self._on_launch is not None:
-            self._on_launch(self)
         pos = 0
         for item in batch:
             n = len(item.entries)
             if not item.done.done():
                 item.done.set_result(oks[pos:pos + n])
             pos += n
+        # The hook fires only after every awaiter's future is resolved: a
+        # raising hook used to abort _flush before the loop above ran,
+        # hanging every coalesced verify()/verify_many() caller forever.
+        # A hook failure is a metrics/observer problem, never a verify
+        # failure — log and carry on.
+        if self._on_launch is not None:
+            try:
+                self._on_launch(self)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "BatchVerifier on_launch hook raised")

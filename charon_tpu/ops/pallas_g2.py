@@ -45,11 +45,17 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import fp
+from . import vmem_budget
 
 NL = fp.NLIMBS
 MASK = fp.MASK
 LANES = 128
 SUBLANES = 8
+
+# The scoped-VMEM budget model (ops/vmem_budget) sizes every kernel's S
+# tile; its copies of the layout constants must agree with the real ones.
+assert vmem_budget.NLIMBS == NL
+assert vmem_budget.LANES == LANES and vmem_budget.SUBLANES == SUBLANES
 
 # Set by tests to run kernels in pallas interpret mode (CPU validation).
 INTERPRET = False
@@ -93,14 +99,22 @@ _OFF2 = _spread_multiple(65, 1 << 14)      # 66 digits
 # Fold-constant table: worst fold width is 68 (66 lazy-combine columns
 # widened by two carry rounds) → 36 high columns.
 _FC_ROWS = 36
-_FC_NP = fp.FOLDC[:_FC_ROWS].astype(np.int32)          # [34, 32]
+_FC_NP = fp.FOLDC[:_FC_ROWS].astype(np.int32)          # [36, 32]
+assert vmem_budget.FC_ROWS == _FC_ROWS
 
 
 def fold_consts() -> np.ndarray:
-    """The `fc` kernel input: fold constants broadcast to vreg shape."""
+    """The `fc` kernel input: fold constants with the limb axis on
+    sublanes, broadcast across lanes only — [FC_ROWS, NL, 128].
+
+    Round 5 broadcast this table to full vreg shape [36, 32, 8, 128];
+    that single operand held 4.5 MiB of the 16 MiB scoped-VMEM space and
+    was the largest item in the budget the Straus kernel blew
+    (BENCH_r05.json).  In this layout nothing pads (32 sublanes, 128
+    lanes) and the block costs 576 KiB; kernels re-broadcast along the
+    row axis for free via jnp broadcasting (see _fc_load/_fold)."""
     return np.ascontiguousarray(
-        np.broadcast_to(_FC_NP[:, :, None, None],
-                        (_FC_ROWS, NL, SUBLANES, LANES)))
+        np.broadcast_to(_FC_NP[:, :, None], (_FC_ROWS, NL, LANES)))
 
 
 _SPREAD = [int(v) for v in fp.SPREAD48P]               # 33 digits
@@ -110,6 +124,21 @@ _SPREAD = [int(v) for v in fp.SPREAD48P]               # 33 digits
 # In-kernel field library.  An Fp element is a [W, 8, 128] int32 array
 # (limb axis leading); an Fp2 element is a (c0, c1) tuple.  `fc` is the
 # fold-constant array read from the kernel input.
+#
+# The heavy primitives (_conv, _fold, _add_off, _spread_arr) each have two
+# forms dispatched on the DIRECT switch:
+# - the UNROLLED form (per-column slices/multiplies, per-limb literals) is
+#   what Mosaic can lower inside a pallas kernel;
+# - the COLLAPSED form used in DIRECT mode folds the same arithmetic into
+#   one dot_general / one constant-array op.  Left unrolled, one fused
+#   group-law step traces to ~50k primitives and XLA CPU compiles of the
+#   MSM drivers took minutes (tier-1 timed out inside test_pallas_g2;
+#   jitting the sharded combine never finished at all).  Collapsed, the
+#   same tests run in seconds.
+# Both forms are exact int32 arithmetic — sums of identical terms in a
+# different association order — so outputs are BIT-IDENTICAL and the
+# differential tests compare them directly (the slow interpret lane runs
+# the true unrolled kernel form against DIRECT outputs).
 # ---------------------------------------------------------------------------
 
 def _zrow(x, n=1):
@@ -130,6 +159,10 @@ def _fold(fc, x):
     """[W ≥ 32, 8, 128] → [32, 8, 128], value preserved mod p."""
     h = x.shape[0] - NL
     assert h <= _FC_ROWS
+    if DIRECT and h:
+        # one dot_general over the fold rows instead of h unrolled FMAs
+        fc2 = jnp.asarray(_FC_NP[:h])                   # [h, NL]
+        return x[:NL] + jnp.einsum("j...,ji->i...", x[NL:], fc2)
     acc = x[:NL]
     for j in range(h):
         acc = acc + x[NL + j][None] * fc[j]
@@ -150,14 +183,24 @@ def _addf(fc, a, b):
 def _add_off(cols, off):
     """Add per-column integer literals (a spread multiple of p)."""
     w = cols.shape[0]
+    if DIRECT:
+        off32 = jnp.asarray(np.asarray(off[:w], np.int32))[:, None, None]
+        last = jnp.full((1,) + cols.shape[1:], int(off[w]), jnp.int32)
+        return jnp.concatenate([cols + off32, last], axis=0)
     out = [cols[i] + int(off[i]) for i in range(w)]
     out.append(jnp.full(cols.shape[1:], int(off[w]), jnp.int32))
     return jnp.concatenate([c[None] for c in out], axis=0)
 
 
+_SPREAD_NP = np.asarray(_SPREAD, np.int32)
+
+
 def _spread_arr(like):
     """SPREAD48P (≡ 0 mod p, every low limb ≥ LMAX) as a stack of per-limb
     literal columns shaped like `like` (33 limbs)."""
+    if DIRECT:
+        return jnp.broadcast_to(jnp.asarray(_SPREAD_NP)[:, None, None],
+                                (len(_SPREAD),) + like.shape[1:])
     return jnp.concatenate(
         [jnp.full((1,) + like.shape[1:], v, jnp.int32) for v in _SPREAD],
         axis=0)
@@ -180,6 +223,16 @@ def _msmall(fc, a, k):
 
 def _conv(a, b):
     """63 raw convolution columns (each < 2^31 for limbs ≤ LMAX)."""
+    if DIRECT:
+        # band[j, k] = b[k − j] (zero outside): 32 static slices + ONE
+        # batched dot_general instead of 63 unrolled column sums
+        sp = b.shape[1:]
+        pad = jnp.zeros((NL - 1,) + sp, jnp.int32)
+        bp = jnp.concatenate([pad, b, pad], axis=0)
+        band = jnp.stack([
+            lax.slice_in_dim(bp, NL - 1 - j, NL - 1 - j + 2 * NL - 1,
+                             axis=0) for j in range(NL)])
+        return jnp.einsum("j...,jk...->k...", a, band)
     b_rev = jnp.concatenate([b[j][None] for j in range(NL - 1, -1, -1)])
     cols = []
     for k in range(2 * NL - 1):
@@ -288,12 +341,18 @@ def _g2_add(fc, p1, p2):
 # Kernels
 # ---------------------------------------------------------------------------
 
+def _fc_load(fc_ref):
+    """Kernel-side fc: the [FC_ROWS, NL, LANES] block → broadcastable
+    [FC_ROWS, NL, 1, LANES] (rows re-broadcast inside _fold for free)."""
+    return fc_ref[...][:, :, None, :]
+
+
 def _dbl_kernel(fc_ref, p_ref, o_ref):
-    o_ref[...] = _g2_double(fc_ref[...], p_ref[...])
+    o_ref[...] = _g2_double(_fc_load(fc_ref), p_ref[...])
 
 
 def _add_kernel(fc_ref, a_ref, b_ref, o_ref):
-    o_ref[...] = _g2_add(fc_ref[...], a_ref[...], b_ref[...])
+    o_ref[...] = _g2_add(_fc_load(fc_ref), a_ref[...], b_ref[...])
 
 
 def _sel(w, t1_ref, t2_ref, t3_ref):
@@ -301,102 +360,147 @@ def _sel(w, t1_ref, t2_ref, t3_ref):
                      jnp.where(w == 2, t2_ref[...], t3_ref[...]))
 
 
-def _addsel_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, w_ref, o_ref):
+def _addsel_body(fc, acc, t1, t2, t3, w):
     """acc ← acc + table[w] for w ∈ {1,2,3}; w = 0 keeps acc unchanged
-    (cheaper than a complete addition of ∞: select the input back)."""
-    fc = fc_ref[...]
-    w = w_ref[...][None, None, :, :]
-    added = _g2_add(fc, acc_ref[...], _sel(w, t1_ref, t2_ref, t3_ref))
-    o_ref[...] = jnp.where(w == 0, acc_ref[...], added)
+    (cheaper than a complete addition of ∞: select the input back).
+
+    The ONE copy of the select/add/keep logic: the pallas kernel and the
+    DIRECT form both delegate here (table operands may be refs or arrays
+    — _sel reads via [...]), so the bit-identical contract between the
+    two modes cannot drift."""
+    wb = w[None, None, :, :]
+    added = _g2_add(fc, acc, _sel(wb, t1, t2, t3))
+    return jnp.where(wb == 0, acc, added)
+
+
+def _dblsel_body(fc, acc, t1, t2, t3, w):
+    """One fused 2-bit MSM iteration: acc ← 4·acc (+ table[w])."""
+    acc4 = _g2_double(fc, _g2_double(fc, acc))
+    wb = w[None, None, :, :]
+    added = _g2_add(fc, acc4, _sel(wb, t1, t2, t3))
+    return jnp.where(wb == 0, acc4, added)
+
+
+def _addsel_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, w_ref, o_ref):
+    o_ref[...] = _addsel_body(_fc_load(fc_ref), acc_ref[...],
+                              t1_ref, t2_ref, t3_ref, w_ref[...])
 
 
 def _dblsel_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, w_ref, o_ref):
-    """One fused 2-bit MSM iteration: acc ← 4·acc (+ table[w]), every
-    intermediate in VMEM — one launch per iteration."""
-    fc = fc_ref[...]
-    acc4 = _g2_double(fc, _g2_double(fc, acc_ref[...]))
-    w = w_ref[...][None, None, :, :]
-    added = _g2_add(fc, acc4, _sel(w, t1_ref, t2_ref, t3_ref))
-    o_ref[...] = jnp.where(w == 0, acc4, added)
+    """Every intermediate in VMEM — one launch per iteration."""
+    o_ref[...] = _dblsel_body(_fc_load(fc_ref), acc_ref[...],
+                              t1_ref, t2_ref, t3_ref, w_ref[...])
 
 
-@functools.lru_cache(maxsize=8)
-def _calls(s_blocks: int, interpret: bool):
+def _build_call(kernel, n_pts: int, with_w: bool, s_rows: int,
+                interpret: bool, budget: int):
+    """One pallas_call with its S tile sized by the scoped-VMEM budget:
+    the largest tile (multiple of 8 rows, dividing S) whose per-grid-step
+    working set — revolving point blocks, the single fc block, the digit
+    plane, and the value stack — fits `budget` (ops/vmem_budget)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    def pt_spec():
-        return pl.BlockSpec((6, NL, SUBLANES, LANES), lambda i: (0, 0, i, 0),
-                            memory_space=pltpu.VMEM)
-
-    fc_spec = pl.BlockSpec((_FC_ROWS, NL, SUBLANES, LANES),
-                           lambda i: (0, 0, 0, 0), memory_space=pltpu.VMEM)
-    w_spec = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0),
+    tile = vmem_budget.pick_tile_rows(n_pts, s_rows, with_digits=with_w,
+                                      budget=budget)
+    pt_spec = pl.BlockSpec((6, NL, tile, LANES), lambda i: (0, 0, i, 0),
+                           memory_space=pltpu.VMEM)
+    fc_spec = pl.BlockSpec((_FC_ROWS, NL, LANES), lambda i: (0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((tile, LANES), lambda i: (i, 0),
                           memory_space=pltpu.VMEM)
+    in_specs = [fc_spec] + [pt_spec] * n_pts + ([w_spec] if with_w else [])
+    return pl.pallas_call(
+        kernel,
+        grid=(s_rows // tile,),
+        in_specs=in_specs,
+        out_specs=pt_spec,
+        out_shape=jax.ShapeDtypeStruct((6, NL, s_rows, LANES), jnp.int32),
+        interpret=interpret,
+    )
 
-    def build(kernel, n_pts, with_w):
-        in_specs = [fc_spec] + [pt_spec() for _ in range(n_pts)]
-        if with_w:
-            in_specs.append(w_spec)
-        shape = (6, NL, s_blocks * SUBLANES, LANES)
-        return pl.pallas_call(
-            kernel,
-            grid=(s_blocks,),
-            in_specs=in_specs,
-            out_specs=pt_spec(),
-            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
-            interpret=interpret,
-        )
 
+@functools.lru_cache(maxsize=16)
+def _calls(s_blocks: int, interpret: bool, budget: int):
+    s_rows = s_blocks * SUBLANES
     return {
-        "dbl": build(_dbl_kernel, 1, False),
-        "add": build(_add_kernel, 2, False),
-        "addsel": build(_addsel_kernel, 4, True),
-        "dblsel": build(_dblsel_kernel, 4, True),
+        "dbl": _build_call(_dbl_kernel, 1, False, s_rows, interpret, budget),
+        "add": _build_call(_add_kernel, 2, False, s_rows, interpret, budget),
+        "addsel": _build_call(_addsel_kernel, 4, True, s_rows, interpret,
+                              budget),
+        "dblsel": _build_call(_dblsel_kernel, 4, True, s_rows, interpret,
+                              budget),
     }
 
 
 def _get(name: str, s: int):
     assert s % SUBLANES == 0, f"S={s} must be a multiple of {SUBLANES}"
-    return _calls(s // SUBLANES, INTERPRET)[name]
+    return _calls(s // SUBLANES, INTERPRET, vmem_budget.budget_bytes())[name]
 
 
 def _fc_direct(fc):
-    """DIRECT mode: the fold constants are lane/sublane-invariant, so
-    collapse the broadcast [36, 32, 8, 128] to [36, 32, 1, 1] and let jnp
-    broadcasting fit any tile height S (pallas blocks are always S=8)."""
-    return fc[:, :, :1, :1]
+    """DIRECT mode: the fold constants are lane-invariant, so collapse
+    the [36, 32, 128] table to [36, 32, 1, 1] and let jnp broadcasting
+    fit any tile height S."""
+    return fc[:, :, None, :1]
+
+
+def _direct_dbl(fc, p):
+    return _g2_double(_fc_direct(fc), p)
+
+
+def _direct_add(fc, a, b):
+    return _g2_add(_fc_direct(fc), a, b)
+
+
+def _direct_addsel(fc, acc, p1, p2, p3, w):
+    return _addsel_body(_fc_direct(fc), acc, p1, p2, p3, w)
+
+
+def _direct_dblsel(fc, acc, p1, p2, p3, w):
+    return _dblsel_body(_fc_direct(fc), acc, p1, p2, p3, w)
+
+
+def _direct_addsel_s(fc, acc, t1, t2, t3, t4, w):
+    return _addsel_s_body(_fc_direct(fc), acc, t1, t2, t3, t4, w)
+
+
+def _direct_dbl3sel_s(fc, acc, t1, t2, t3, t4, w):
+    return _dbl3sel_s_body(_fc_direct(fc), acc, t1, t2, t3, t4, w)
+
+
+@functools.lru_cache(maxsize=None)
+def _direct_jit(name: str):
+    """DIRECT-mode kernel math, jit-wrapped and cached per kernel: every
+    call site — each iteration of the t-unrolled combine loop, every
+    differential test — reuses ONE compiled computation per shape instead
+    of re-inlining a multi-thousand-op graph.  Traced while DIRECT is
+    set, so the collapsed _conv/_fold forms are baked in."""
+    return jax.jit(_DIRECT_FNS[name])
 
 
 def dbl(fc, p):
     """[6, 32, S, 128] tiled G2 points → doubled points."""
     if DIRECT:
-        return _g2_double(_fc_direct(fc), p)
+        return _direct_jit("dbl")(fc, p)
     return _get("dbl", p.shape[2])(fc, p)
 
 
 def add(fc, a, b):
     if DIRECT:
-        return _g2_add(_fc_direct(fc), a, b)
+        return _direct_jit("add")(fc, a, b)
     return _get("add", a.shape[2])(fc, a, b)
 
 
 def addsel(fc, acc, p1, p2, p3, w):
     if DIRECT:
-        fc = _fc_direct(fc)
-        wb = w[None, None, :, :]
-        added = _g2_add(fc, acc, _sel(wb, p1, p2, p3))
-        return jnp.where(wb == 0, acc, added)
+        return _direct_jit("addsel")(fc, acc, p1, p2, p3, w)
     return _get("addsel", acc.shape[2])(fc, acc, p1, p2, p3, w)
 
 
 def dblsel(fc, acc, p1, p2, p3, w):
     if DIRECT:
-        fc = _fc_direct(fc)
-        acc4 = _g2_double(fc, _g2_double(fc, acc))
-        wb = w[None, None, :, :]
-        added = _g2_add(fc, acc4, _sel(wb, p1, p2, p3))
-        return jnp.where(wb == 0, acc4, added)
+        return _direct_jit("dblsel")(fc, acc, p1, p2, p3, w)
     return _get("dblsel", acc.shape[2])(fc, acc, p1, p2, p3, w)
 
 
@@ -489,17 +593,25 @@ def msm_combine(fc, pts_t, windows, t_count: int):
 #
 #     acc ← 8·acc + Σ_t d_{t,i}·P_t      per 3-bit window i (MSB-first)
 #
-# so a T=7 combine costs 86·(3 dbl + 7 add) = 9,288 Fp2-products per
-# validator instead of 7·128·(2 dbl + 1 add) = 25,088 — 2.7× fewer.  The
-# T-axis tree sum disappears (folded into the joint accumulation).
+# so a T=7 combine costs 87·(3 dbl + 7 add) = 9,396 Fp2-products per
+# validator (256-bit scalar planes recode to nwin = 87 balanced base-8
+# digits: ⌈258/3⌉ = 86 plus the top carry digit) instead of
+# 7·128·(2 dbl + 1 add) = 25,088 — 2.7× fewer.  The T-axis tree sum
+# disappears (folded into the joint accumulation).
 #
 # Windows are BALANCED base-8 digits d ∈ [−4, 3]: the table per point is
 # only {P, 2P, 3P, 4P} and negative digits negate Y in-kernel (negation is
 # 2 cheap spread-subtractions — reference CPU combine has no analogue of
 # any of this; it interpolates per validator: tbls/tss.go:142-149).
 # Each iteration launches 1 fused dbl³+add kernel (t = 0) plus T−1 add
-# kernels (t > 0): VMEM holds one 4-entry table + acc double-buffered
-# (~9.4 MB), under the 16 MB budget that forbids a single 7-table kernel.
+# kernels (t > 0).  Per-grid-step VMEM is budgeted, not hoped for: the S
+# tile of every kernel is sized by ops/vmem_budget.pick_tile_rows so the
+# working set (acc + 4 table slices + digit plane, revolving buffers,
+# fold constants, value stack) stays under the configurable scoped-VMEM
+# budget (default 14 MiB of the 16 MiB limit; CHARON_TPU_VMEM_BUDGET_MB).
+# Round 5 shipped this path with an unchecked 17.48 MiB working set and
+# the bench died at AOT compile — tests/test_vmem_budget.py now pins the
+# footprint for every shape the backend emits.
 # ---------------------------------------------------------------------------
 
 def signed_digit_rows(bits: np.ndarray) -> np.ndarray:
@@ -553,85 +665,82 @@ def _signed_sel(fc, w, t1_ref, t2_ref, t3_ref, t4_ref):
     return _neg_y_where(fc, pt, w < 0)
 
 
+def _addsel_s_body(fc, acc, t1, t2, t3, t4, w):
+    """acc ← acc ± table[|w|] for w ∈ [−4, 4]; w = 0 keeps acc.  Shared
+    between the pallas kernel and the DIRECT form, like _addsel_body."""
+    wb = w[None, None, :, :]
+    added = _g2_add(fc, acc, _signed_sel(fc, wb, t1, t2, t3, t4))
+    return jnp.where(wb == 0, acc, added)
+
+
+def _dbl3sel_s_body(fc, acc, t1, t2, t3, t4, w):
+    """One fused head step of a 3-bit window: acc ← 8·acc (± table[|w|])."""
+    acc8 = _g2_double(fc, _g2_double(fc, _g2_double(fc, acc)))
+    wb = w[None, None, :, :]
+    added = _g2_add(fc, acc8, _signed_sel(fc, wb, t1, t2, t3, t4))
+    return jnp.where(wb == 0, acc8, added)
+
+
 def _addsel_s_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, t4_ref,
                      w_ref, o_ref):
-    """acc ← acc ± table[|w|] for w ∈ [−4, 4]; w = 0 keeps acc."""
-    fc = fc_ref[...]
-    w = w_ref[...][None, None, :, :]
-    added = _g2_add(fc, acc_ref[...],
-                    _signed_sel(fc, w, t1_ref, t2_ref, t3_ref, t4_ref))
-    o_ref[...] = jnp.where(w == 0, acc_ref[...], added)
+    o_ref[...] = _addsel_s_body(_fc_load(fc_ref), acc_ref[...],
+                                t1_ref, t2_ref, t3_ref, t4_ref, w_ref[...])
 
 
 def _dbl3sel_s_kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, t4_ref,
                       w_ref, o_ref):
-    """One fused head step of a 3-bit window: acc ← 8·acc (± table[|w|])."""
-    fc = fc_ref[...]
-    acc8 = _g2_double(fc, _g2_double(fc, _g2_double(fc, acc_ref[...])))
-    w = w_ref[...][None, None, :, :]
-    added = _g2_add(fc, acc8,
-                    _signed_sel(fc, w, t1_ref, t2_ref, t3_ref, t4_ref))
-    o_ref[...] = jnp.where(w == 0, acc8, added)
+    o_ref[...] = _dbl3sel_s_body(_fc_load(fc_ref), acc_ref[...],
+                                 t1_ref, t2_ref, t3_ref, t4_ref, w_ref[...])
 
 
-@functools.lru_cache(maxsize=8)
-def _straus_calls(s_blocks: int, interpret: bool):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    def pt_spec():
-        return pl.BlockSpec((6, NL, SUBLANES, LANES), lambda i: (0, 0, i, 0),
-                            memory_space=pltpu.VMEM)
-
-    fc_spec = pl.BlockSpec((_FC_ROWS, NL, SUBLANES, LANES),
-                           lambda i: (0, 0, 0, 0), memory_space=pltpu.VMEM)
-    w_spec = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0),
-                          memory_space=pltpu.VMEM)
-
-    def build(kernel):
-        shape = (6, NL, s_blocks * SUBLANES, LANES)
-        return pl.pallas_call(
-            kernel,
-            grid=(s_blocks,),
-            in_specs=[fc_spec] + [pt_spec() for _ in range(5)] + [w_spec],
-            out_specs=pt_spec(),
-            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
-            interpret=interpret,
-        )
-
-    return {"addsel_s": build(_addsel_s_kernel),
-            "dbl3sel_s": build(_dbl3sel_s_kernel)}
+@functools.lru_cache(maxsize=16)
+def _straus_calls(s_blocks: int, interpret: bool, budget: int):
+    s_rows = s_blocks * SUBLANES
+    return {
+        "addsel_s": _build_call(_addsel_s_kernel, 5, True, s_rows,
+                                interpret, budget),
+        "dbl3sel_s": _build_call(_dbl3sel_s_kernel, 5, True, s_rows,
+                                 interpret, budget),
+    }
 
 
 def _sget(name: str, s: int):
     assert s % SUBLANES == 0
-    return _straus_calls(s // SUBLANES, INTERPRET)[name]
+    return _straus_calls(s // SUBLANES, INTERPRET,
+                         vmem_budget.budget_bytes())[name]
 
 
 def addsel_s(fc, acc, t1, t2, t3, t4, w):
     if DIRECT:
-        fc = _fc_direct(fc)
-        wb = w[None, None, :, :]
-        added = _g2_add(fc, acc, _signed_sel(fc, wb, t1, t2, t3, t4))
-        return jnp.where(wb == 0, acc, added)
+        return _direct_jit("addsel_s")(fc, acc, t1, t2, t3, t4, w)
     return _sget("addsel_s", acc.shape[2])(fc, acc, t1, t2, t3, t4, w)
 
 
 def dbl3sel_s(fc, acc, t1, t2, t3, t4, w):
     if DIRECT:
-        fc = _fc_direct(fc)
-        acc8 = _g2_double(fc, _g2_double(fc, _g2_double(fc, acc)))
-        wb = w[None, None, :, :]
-        added = _g2_add(fc, acc8, _signed_sel(fc, wb, t1, t2, t3, t4))
-        return jnp.where(wb == 0, acc8, added)
+        return _direct_jit("dbl3sel_s")(fc, acc, t1, t2, t3, t4, w)
     return _sget("dbl3sel_s", acc.shape[2])(fc, acc, t1, t2, t3, t4, w)
 
 
-def straus_combine(fc, pts_t, digits, t_count: int):
+_DIRECT_FNS = {
+    "dbl": _direct_dbl,
+    "add": _direct_add,
+    "addsel": _direct_addsel,
+    "dblsel": _direct_dblsel,
+    "addsel_s": _direct_addsel_s,
+    "dbl3sel_s": _direct_dbl3sel_s,
+}
+
+
+def straus_combine(fc, pts_t, digits, t_count: int, acc0=None):
     """Joint-T Straus MSM over a t-major tiled batch.
 
     pts_t  [6, 32, S, 128]  t-major rows (row = t·Vpad + v),
     digits [nwin, S, 128]   balanced base-8 digits, iteration-major,
+    acc0   optional [6, 32, Sv, 128] initial accumulator (defaults to ∞).
+           Under shard_map the fori_loop carry must already be
+           device-varying — pass one derived for the mesh (see
+           backend_tpu.straus_combine_sharded, the round-5 sharding bug).
     → [6, 32, Sv, 128] combined points (Sv = S / t_count)."""
     s = pts_t.shape[2]
     assert s % t_count == 0
@@ -655,4 +764,6 @@ def straus_combine(fc, pts_t, digits, t_count: int):
             acc = addsel_s(fc, acc, *tables[k], wk)
         return acc
 
-    return lax.fori_loop(0, nwin, body, inf_tiled(sv))
+    if acc0 is None:
+        acc0 = inf_tiled(sv)
+    return lax.fori_loop(0, nwin, body, acc0)

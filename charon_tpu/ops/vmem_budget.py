@@ -1,0 +1,136 @@
+"""Scoped-VMEM budget model for the fused G2 kernels (ops/pallas_g2).
+
+Round 5 made the Straus joint-T combine the default TPU path without ever
+checking its per-grid-step working set against the compiler: on v5e the
+dbl³+add kernel needed 17.48 MiB of scoped VMEM against the 16 MiB hard
+limit and the headline bench died at AOT compile (BENCH_r05.json, rc=1).
+This module is the single source of truth for that footprint so it can
+never silently drift again: the kernel builders in ops/pallas_g2 size
+their S tiles with `pick_tile_rows()`, and tests/test_vmem_budget.py
+re-derives the footprint for every (V, T) shape the backend emits and
+asserts it stays under budget — a kernel that cannot fit is caught on
+CPU by tier-1, not on the TPU by the bench.
+
+Footprint model, calibrated against the r05 Mosaic report (the one data
+point where the compiler printed its own accounting):
+
+- every point operand (inputs AND the output) contributes one
+  ``[6, NLIMBS, tile_rows, 128]`` int32 block, double-buffered by the
+  Mosaic pipeline;
+- the fold-constant operand is ``[FC_ROWS, NLIMBS, 128]`` int32 with a
+  grid-invariant index map — Mosaic keeps a single buffer for it (the
+  r05 numbers only reconcile with 1× for the constant block);
+- the digit/window plane is ``[tile_rows, 128]`` int32, double-buffered;
+- kernel-body intermediates (the Mosaic value stack) scale linearly with
+  tile rows.  r05 measured 17.48 MiB total for the deepest kernel
+  (dbl³ + signed-select + add) at 8 rows with a 4.5 MiB broadcast fc
+  block: 17.48 − 4.5 (fc) − 9.0 (12 revolving point blocks) ≈ 4.0 MiB of
+  stack per 8-row block.  We budget 512 KiB/row — the measured value
+  with a small safety margin, for every kernel in the family.
+
+The default budget (14 MiB, ``CHARON_TPU_VMEM_BUDGET_MB`` to override)
+deliberately leaves ~2 MiB of the 16 MiB scoped-VMEM space for compiler
+spills the model cannot see.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Layout constants.  ops/pallas_g2 asserts these match its own (which
+# derive from ops/fp); duplicated here so the budget model and its tests
+# import nothing heavy.
+LANES = 128
+SUBLANES = 8
+NLIMBS = 32
+FC_ROWS = 36
+POINT_PLANES = 6            # (X0, X1, Y0, Y1, Z0, Z1)
+INT32 = 4
+
+#: Mosaic value-stack bytes per S row, calibrated on the round-5 v5e
+#: compiler report for the dbl³+add kernel (≈4.0 MiB per 8-row block,
+#: rounded up).  Applied to every kernel in the family — the shallower
+#: kernels (dbl, add) simply get extra margin.
+STACK_BYTES_PER_ROW = 512 * 1024
+
+#: Scoped-VMEM hard limit on current TPUs (the number in the r05 OOM).
+HARD_LIMIT_BYTES = 16 * 1024 * 1024
+
+DEFAULT_BUDGET_MB = 14.0
+_BUDGET_ENV = "CHARON_TPU_VMEM_BUDGET_MB"
+
+
+def budget_bytes() -> int:
+    """The configured scoped-VMEM budget (MiB granularity, env override).
+
+    An override above the 16 MiB scoped-VMEM hard limit is rejected here,
+    not at TPU compile time: pick_tile_rows' over-budget error suggests
+    raising the env knob, and silently accepting a value the compiler
+    cannot honor would re-create the round-5 AOT OOM this module exists
+    to prevent."""
+    mb = float(os.environ.get(_BUDGET_ENV, DEFAULT_BUDGET_MB))
+    budget = int(mb * 1024 * 1024)
+    if budget > HARD_LIMIT_BYTES:
+        raise ValueError(
+            f"{_BUDGET_ENV}={mb} exceeds the {HARD_LIMIT_BYTES} B scoped-"
+            f"VMEM hard limit; kernels admitted against it would still die "
+            f"at TPU compile")
+    return budget
+
+
+def point_block_bytes(tile_rows: int) -> int:
+    """One [6, NLIMBS, tile_rows, LANES] int32 point block."""
+    return POINT_PLANES * NLIMBS * tile_rows * LANES * INT32
+
+
+def fc_block_bytes() -> int:
+    """The [FC_ROWS, NLIMBS, LANES] fold-constant block (the limb axis
+    lives on sublanes, so nothing pads)."""
+    return FC_ROWS * NLIMBS * LANES * INT32
+
+
+def digit_block_bytes(tile_rows: int) -> int:
+    """One [tile_rows, LANES] int32 digit/window plane block."""
+    return tile_rows * LANES * INT32
+
+
+def step_footprint_bytes(n_point_inputs: int, tile_rows: int,
+                         with_digits: bool = True) -> int:
+    """Scoped-VMEM bytes one grid step of a pallas_g2 kernel holds live:
+    revolving point blocks (inputs + output, 2× each), the single-buffered
+    fold-constant block, the digit plane, and the value stack."""
+    pts = (n_point_inputs + 1) * 2 * point_block_bytes(tile_rows)
+    digits = 2 * digit_block_bytes(tile_rows) if with_digits else 0
+    stack = STACK_BYTES_PER_ROW * tile_rows
+    return pts + fc_block_bytes() + digits + stack
+
+
+def pick_tile_rows(n_point_inputs: int, s_rows: int,
+                   with_digits: bool = True,
+                   budget: int | None = None) -> int:
+    """Largest S tile (rows, multiple of SUBLANES, dividing `s_rows`)
+    whose per-grid-step footprint stays under the scoped-VMEM budget.
+
+    Raises if even the minimum 8-row tile does not fit — that means the
+    kernel family itself is over budget and no grid shape can save it.
+    """
+    if s_rows % SUBLANES:
+        raise ValueError(f"S={s_rows} rows not a multiple of {SUBLANES}")
+    if budget is None:
+        budget = budget_bytes()
+    best = 0
+    tile = SUBLANES
+    while tile <= s_rows:
+        if s_rows % tile == 0 and \
+                step_footprint_bytes(n_point_inputs, tile,
+                                     with_digits) <= budget:
+            best = tile
+        tile += SUBLANES
+    if not best:
+        need = step_footprint_bytes(n_point_inputs, SUBLANES, with_digits)
+        raise ValueError(
+            f"pallas_g2 kernel with {n_point_inputs} point inputs needs "
+            f"{need} B of scoped VMEM at the minimum 8-row tile, over the "
+            f"{budget} B budget ({_BUDGET_ENV} to raise it; hard limit "
+            f"{HARD_LIMIT_BYTES} B)")
+    return best

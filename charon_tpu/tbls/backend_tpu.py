@@ -20,6 +20,7 @@ O(log n) times across workload sizes.
 from __future__ import annotations
 
 import functools
+import math
 import os
 
 import jax
@@ -145,6 +146,71 @@ def _msm_kind() -> str:
     return os.environ.get("CHARON_TPU_MSM", "straus")
 
 
+#: Scalar-plane widths of the fused combine paths: 256-bit scalars recode
+#: to ⌈258/3⌉ + 1 carry = 87 balanced base-8 digits (straus) or 256 bit
+#: planes (dblsel).  Module-level, not inline literals, so the tier-1
+#: smoke (tests/test_bench_smoke.py) can shrink the window loop and still
+#: drive the identical host + kernel path.
+STRAUS_NWIN = 87
+DBLSEL_NBITS = jcurve.SCALAR_BITS
+
+
+def _varying_inf_tiled(sv: int, axis_names):
+    """∞ accumulator typed device-varying for a shard_map body.
+
+    Newer JAX tracks varying manual axes on loop carries: a replicated-
+    constant fori_loop init no longer unifies with the dp-varying body
+    output (the round-5 carry mismatch that broke straus_combine under
+    shard_map).  lax.pvary marks the constant as varying over the mesh
+    axis; older JAX (no lax.pvary) adjusts replication automatically and
+    the plain constant is fine."""
+    acc0 = pallas_g2.inf_tiled(sv)
+    pvary = getattr(jax.lax, "pvary", None)
+    return pvary(acc0, axis_names) if pvary is not None else acc0
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_combine_fn(mesh, t: int, nwin: int, direct: bool):
+    """The jitted shard_map combine program for one (mesh, T, nwin) family.
+
+    Cached so every slot with the same share count reuses ONE compiled
+    program — shard_map closures are fresh objects per call, so without
+    this cache jax.jit re-traced the whole device program every combine.
+    `direct` keys the cache on pallas_g2.DIRECT (a trace-time switch):
+    a CPU-mesh trace must never be served to a TPU caller or vice versa."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(p, d):
+        vl = p.shape[0]
+        rows = p.transpose(1, 0, 2, 3, 4).reshape(vl * t, 3, 2, p.shape[-1])
+        digits = d.transpose(2, 1, 0).reshape(nwin, (t * vl) // 128, 128)
+        fc = jnp.asarray(pallas_g2.fold_consts())
+        acc0 = _varying_inf_tiled(vl // 128, ("dp",))
+        out = pallas_g2.straus_combine(fc, pallas_g2.tile_points(rows),
+                                       digits, t, acc0=acc0)
+        return pallas_g2.untile_points(out)
+
+    return jax.jit(shard_map(local, mesh=mesh,
+                             in_specs=(P("dp"), P("dp")), out_specs=P("dp")))
+
+
+def _v_granularity(t: int) -> int:
+    """Per-device V padding granularity of the sharded combine.
+
+    Two constraints on v_local: tile_points needs t·v_local ≡ 0 (mod
+    1024), and straus_combine slices the t-major S axis into t_count
+    equal accumulator-shaped pieces of v_local/128 rows each, so
+    v_local ≡ 0 (mod 128) regardless of t (both moduli are powers of
+    two, so max = lcm).  The pallas kernels additionally need the
+    accumulator on the 8-sublane grid, i.e. v_local ≡ 0 (mod 1024);
+    DIRECT mode (the CPU-mesh suites) has no sublane grid, so the
+    cheaper bound keeps the 8-virtual-device tests small."""
+    if pallas_g2.DIRECT:
+        return max(1024 // math.gcd(t, 1024), 128)
+    return pallas_g2.SUBLANES * pallas_g2.LANES
+
+
 def straus_combine_sharded(mesh, pts_vt, digits_vt):
     """Multi-chip fused combine: shard the validator batch (the framework's
     data-parallel axis, SURVEY.md §2.9) over `mesh`'s "dp" axis and run the
@@ -153,31 +219,35 @@ def straus_combine_sharded(mesh, pts_vt, digits_vt):
 
     pts_vt    [V, T, 3, 2, 32]  per-validator share points,
     digits_vt [V, T, nwin]      balanced base-8 Lagrange digits,
-    → [V, 3, 2, 32] combined group-signature points, dp-sharded.
+    → [V, 3, 2, 32] combined group-signature points.
 
-    Each device transposes its local batch to the t-major tiled row layout
-    (local rows = T·V_local must be a multiple of 1024) and runs the same
+    V is padded host-side so every device's local row count T·V_local
+    lands on the 1024-row tile grid: padded validators are ∞ points with
+    all-zero digits (every window keeps the accumulator), so they combine
+    to ∞ and are sliced off the result.  Each device then transposes its
+    local batch to the t-major tiled row layout and runs the same
     `pallas_g2.straus_combine` the single-chip bytes path uses.  This is
-    the sharding shape `__graft_entry__.dryrun_multichip` and
-    tests/test_sharding.py validate on the 8-virtual-device CPU mesh."""
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
+    the production multichip path: `__graft_entry__.dryrun_multichip`
+    drives it standalone, and tests/test_sharding.py validates it (even
+    and uneven V) on the 8-virtual-device CPU mesh."""
     v, t, _, _, nl = pts_vt.shape
     nwin = digits_vt.shape[2]
+    n_dev = mesh.devices.size
+    gran = _v_granularity(t)
+    v_local = -(-max(1, -(-v // n_dev)) // gran) * gran
+    vpad = v_local * n_dev
+    if vpad != v:
+        inf = jcurve.g2_pack([None])[0]
+        pts_vt = jnp.concatenate(
+            [jnp.asarray(pts_vt),
+             jnp.broadcast_to(jnp.asarray(inf), (vpad - v, t, 3, 2, nl))])
+        digits_vt = jnp.concatenate(
+            [jnp.asarray(digits_vt),
+             jnp.zeros((vpad - v, t, nwin), digits_vt.dtype)])
 
-    def local(p, d):
-        vl = p.shape[0]
-        rows = p.transpose(1, 0, 2, 3, 4).reshape(vl * t, 3, 2, nl)
-        digits = d.transpose(2, 1, 0).reshape(nwin, (t * vl) // 128, 128)
-        fc = jnp.asarray(pallas_g2.fold_consts())
-        out = pallas_g2.straus_combine(fc, pallas_g2.tile_points(rows),
-                                       digits, t)
-        return pallas_g2.untile_points(out)
-
-    fn = shard_map(local, mesh=mesh,
-                   in_specs=(P("dp"), P("dp")), out_specs=P("dp"))
-    return jax.jit(fn)(pts_vt, digits_vt)
+    fn = _sharded_combine_fn(mesh, t, nwin, pallas_g2.DIRECT)
+    out = fn(jnp.asarray(pts_vt), jnp.asarray(digits_vt))
+    return out if vpad == v else out[:v]
 
 
 @jax.jit
@@ -319,7 +389,7 @@ class TPUBackend:
         vpad = max(1024, -(-nv // 1024) * 1024)
         t = max(len(sigs) for sigs in batch)
         straus = _msm_kind() == "straus"
-        nwin = 87 if straus else jcurve.SCALAR_BITS
+        nwin = STRAUS_NWIN if straus else DBLSEL_NBITS
         raw = np.broadcast_to(_G2_INF_BYTES, (t, vpad, 96)).copy()
         scal = np.zeros((t, vpad, nwin), np.int32)
         counts = np.zeros(vpad, np.int32)

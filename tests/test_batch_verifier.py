@@ -129,6 +129,30 @@ def test_on_launch_hook_fires():
     assert seen == [(1, 1)]
 
 
+def test_raising_on_launch_hook_cannot_hang_awaiters():
+    """A hook that raises must not abort _flush before verdicts are
+    delivered (the old ordering hung every coalesced awaiter forever) —
+    and the launch after the raising one still runs normally."""
+    def boom(bv):
+        raise RuntimeError("metrics sink down")
+
+    v = BatchVerifier(on_launch=boom)
+    sk, pk = _keypair(b"\x06")
+
+    async def main():
+        return await asyncio.wait_for(
+            asyncio.gather(v.verify(pk, b"m1", tbls.sign(sk, b"m1")),
+                           v.verify(pk, b"m2", tbls.sign(sk, b"wrong"))),
+            timeout=5.0)
+
+    assert asyncio.run(main()) == [True, False]
+    assert v.launches == 1
+    # verifier stays usable after the hook failure
+    assert asyncio.run(asyncio.wait_for(
+        v.verify(pk, b"m3", tbls.sign(sk, b"m3")), 5.0)) is True
+    assert v.launches == 2
+
+
 # ---------------------------------------------------------------------------
 # Wiring: Node routes both verify call-sites through ONE shared verifier
 # ---------------------------------------------------------------------------

@@ -70,18 +70,17 @@ def test_sharded_msm_matches_oracle(mesh):
         assert got[v] == acc, f"row {v} mismatch"
 
 
-def test_sharded_fused_straus_combine(mesh):
-    """The PRODUCTION fused combine path (pallas_g2.straus_combine via
-    backend_tpu.straus_combine_sharded) under the 8-device dp mesh —
-    round-4 verdict item 4: the legacy jnp msm sharding green was evidence
-    for the wrong path.  DIRECT mode runs the identical kernel-body math on
-    the CPU mesh; a real TPU mesh runs the pallas kernels unchanged."""
-    from charon_tpu.ops import pallas_g2
-    from charon_tpu.tbls.backend_tpu import straus_combine_sharded
+_FUSED_T = 4  # local rows = t·v_local = 1024 (tile minimum) at v_local=256
 
-    n_dev = 8
-    t, vl = 4, 256                 # local rows = t·vl = 1024 (tile minimum)
-    v = n_dev * vl
+
+def _fused_case(v: int):
+    """t-major fused-combine inputs with rows cycling over 8 distinct
+    (points, scalars) tuples, so the refcurve oracle costs 8 combines no
+    matter how large V is.  Returns (pts [V,T,3,2,32], digits [V,T,nwin],
+    scal [V,T], the T distinct base points)."""
+    from charon_tpu.ops import pallas_g2
+
+    t = _FUSED_T
     rng = np.random.default_rng(23)
     distinct = [refcurve.multiply(refcurve.G2_GEN, 3 + k)
                 for k in range(t)]
@@ -96,26 +95,84 @@ def test_sharded_fused_straus_combine(mesh):
                            np.int32) for s in row]) for row in scal[:8]])
     digits8 = np.stack([pallas_g2.signed_digit_rows(b) for b in bits])
     digits = digits8[np.arange(v) % 8]              # [V, T, nwin]
+    return pts, digits, scal, distinct
 
-    pallas_g2.DIRECT = True
-    try:
-        out = straus_combine_sharded(mesh, jnp.asarray(pts),
-                                     jnp.asarray(digits))
-    finally:
-        pallas_g2.DIRECT = False
-    assert len(out.sharding.device_set) == 8
 
-    # oracle: the 8 distinct rows via refcurve
+def _assert_fused_oracle(out, scal, distinct):
+    """First 8 rows vs the refcurve oracle, point-exact."""
     got = jcurve.g2_unpack(out[:8])
     for k in range(8):
         acc = None
-        for j in range(t):
+        for j in range(len(distinct)):
             acc = refcurve.add(acc, refcurve.multiply(
                 distinct[j], int(scal[k][j])))
         assert got[k] == acc, f"row {k} mismatch"
+
+
+def _run_fused_sharded(mesh, pts, digits):
+    from charon_tpu.ops import pallas_g2
+    from charon_tpu.tbls.backend_tpu import straus_combine_sharded
+
+    pallas_g2.DIRECT = True
+    try:
+        return straus_combine_sharded(mesh, jnp.asarray(pts),
+                                      jnp.asarray(digits))
+    finally:
+        pallas_g2.DIRECT = False
+
+
+def test_sharded_fused_straus_combine(mesh):
+    """The PRODUCTION fused combine path (pallas_g2.straus_combine via
+    backend_tpu.straus_combine_sharded) under the 8-device dp mesh —
+    round-4 verdict item 4: the legacy jnp msm sharding green was evidence
+    for the wrong path.  DIRECT mode runs the identical kernel-body math on
+    the CPU mesh; a real TPU mesh runs the pallas kernels unchanged."""
+    v = 8 * 256                    # exactly v_local=256 per device, no pad
+    pts, digits, scal, distinct = _fused_case(v)
+    out = _run_fused_sharded(mesh, pts, digits)
+    assert out.shape[0] == v
+    assert len(out.sharding.device_set) == 8
+
+    _assert_fused_oracle(out, scal, distinct)
     # and the repeated rows equal their representatives, bytes-exact
     np.testing.assert_array_equal(np.asarray(out[:8]),
                                   np.asarray(out[8:16]))
+
+
+def test_sharded_v_granularity_arithmetic():
+    """_v_granularity must satisfy BOTH layout constraints in DIRECT mode:
+    t·v_local ≡ 0 (mod 1024) for tile_points AND v_local ≡ 0 (mod 128)
+    for straus_combine's t-major S split (t=16 used to yield gran=64,
+    which traced to a zero-row accumulator and a failed S % t assert)."""
+    from charon_tpu.ops import pallas_g2
+    from charon_tpu.tbls.backend_tpu import _v_granularity
+
+    prev = pallas_g2.DIRECT
+    pallas_g2.DIRECT = True
+    try:
+        for t in (1, 2, 3, 4, 7, 8, 16, 32, 1024, 2048):
+            gran = _v_granularity(t)
+            assert (t * gran) % 1024 == 0, f"t={t}: tile_points bound"
+            assert gran % 128 == 0, f"t={t}: S-split bound"
+    finally:
+        pallas_g2.DIRECT = prev
+    assert _v_granularity(4) % (128 * 8) == 0  # non-DIRECT: sublane grid
+
+
+def test_sharded_fused_straus_combine_uneven_v(mesh):
+    """V = 257 does not divide the mesh: straus_combine_sharded must pad
+    to the per-device tile granularity (v_local = 256 → Vpad = 2048) with
+    ∞ points + zero digits, and slice the padding back off.  The padded
+    per-device shapes match the even test's, so the cached jitted program
+    is reused — this case costs execution only."""
+    v = 257
+    pts, digits, scal, distinct = _fused_case(v)
+    out = _run_fused_sharded(mesh, pts, digits)
+    assert out.shape[0] == v                        # padding sliced off
+
+    _assert_fused_oracle(out, scal, distinct)
+    # the last row (index 256 ≡ 0 mod 8) equals its representative exactly
+    np.testing.assert_array_equal(np.asarray(out[256]), np.asarray(out[0]))
 
 
 def test_sharded_matches_unsharded(mesh):

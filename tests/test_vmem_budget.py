@@ -1,0 +1,125 @@
+"""Scoped-VMEM budget regression tests (ops/vmem_budget).
+
+Round 5 shipped the Straus joint-T combine with a 17.48 MiB per-grid-step
+working set against the TPU's 16 MiB scoped-VMEM limit: the headline bench
+died at AOT compile and nothing on CPU had checked the footprint
+(BENCH_r05.json).  These tests re-derive the working set of every kernel
+in the pallas_g2 family for every (V, T) shape the backend emits and pin
+it under the budget — pure arithmetic, no TPU, no jax — so an over-budget
+kernel is a tier-1 failure, not a hardware-only bench failure.
+"""
+
+import pytest
+
+from charon_tpu.ops import vmem_budget as vb
+
+# (kernel family, point inputs, has digit plane) — must mirror the
+# _build_call sites in ops/pallas_g2: dbl(1), add(2), addsel/dblsel(4),
+# addsel_s/dbl3sel_s(5, the Straus signed-window kernels).
+FAMILIES = [
+    ("dbl", 1, False),
+    ("add", 2, False),
+    ("addsel", 4, True),
+    ("dblsel", 4, True),
+    ("addsel_s", 5, True),
+    ("dbl3sel_s", 5, True),
+]
+
+
+def _fused_s_rows(nv: int, t: int) -> int:
+    """S rows of the single-chip fused combine: _combine_bytes_fused pads
+    V to a 1024-row multiple, rows are t-major (T·Vpad total)."""
+    vpad = max(1024, -(-nv // 1024) * 1024)
+    return t * vpad // vb.LANES
+
+
+def _sharded_s_rows(nv: int, t: int, n_dev: int = 8) -> int:
+    """Per-device S rows of straus_combine_sharded (non-DIRECT: V_local
+    padded to a SUBLANES·LANES multiple)."""
+    gran = vb.SUBLANES * vb.LANES
+    v_local = -(-max(1, -(-nv // n_dev)) // gran) * gran
+    return t * v_local // vb.LANES
+
+
+BACKEND_SHAPES = [(nv, t) for nv in (1, 100, 1024, 4096, 10_000, 50_000)
+                  for t in (1, 2, 3, 4, 7, 10)]
+
+
+@pytest.mark.parametrize("nv,t", BACKEND_SHAPES)
+def test_every_backend_shape_fits_the_budget(nv, t):
+    """For every (V, T) the backend can emit — single-chip fused AND the
+    per-device sharded shard — every kernel family picks an S tile whose
+    per-grid-step footprint fits the configured budget, which itself sits
+    under the 16 MiB hard limit."""
+    budget = vb.budget_bytes()
+    assert budget <= vb.HARD_LIMIT_BYTES
+    for s_rows in (_fused_s_rows(nv, t), _sharded_s_rows(nv, t)):
+        for name, n_pts, with_digits in FAMILIES:
+            tile = vb.pick_tile_rows(n_pts, s_rows, with_digits=with_digits)
+            assert s_rows % tile == 0 and tile % vb.SUBLANES == 0, \
+                f"{name}: tile {tile} does not grid S={s_rows}"
+            foot = vb.step_footprint_bytes(n_pts, tile, with_digits)
+            assert foot <= budget, \
+                f"{name} at V={nv} T={t} S={s_rows}: {foot} B over budget"
+
+
+def test_round5_layout_would_have_been_caught():
+    """Regression pin for the r05 OOM: with the fold-constant table at
+    full vreg broadcast ([36, 32, 8, 128] ≈ 4.5 MiB instead of today's
+    [36, 32, 128] slice) the deepest kernel's minimum-tile footprint
+    exceeds even the 16 MiB HARD limit — exactly the failure the compiler
+    reported.  The budget model must still flag that layout."""
+    old_fc = vb.FC_ROWS * vb.NLIMBS * vb.SUBLANES * vb.LANES * vb.INT32
+    r05 = (vb.step_footprint_bytes(5, vb.SUBLANES) - vb.fc_block_bytes()
+           + old_fc)
+    assert r05 > vb.HARD_LIMIT_BYTES
+    # and the shipped layout fits with headroom below the hard limit
+    now = vb.step_footprint_bytes(5, vb.SUBLANES)
+    assert now <= vb.budget_bytes() < vb.HARD_LIMIT_BYTES
+
+
+def test_pick_tile_rows_maximises_under_budget():
+    # a huge budget lets the whole S land in one tile
+    assert vb.pick_tile_rows(1, 64, budget=1 << 40) == 64
+    # the returned tile is the LARGEST fitting divisor: shrinking the
+    # budget just below the 64-row footprint must drop to the next divisor
+    foot64 = vb.step_footprint_bytes(1, 64)
+    tile = vb.pick_tile_rows(1, 64, budget=foot64 - 1)
+    assert tile < 64 and 64 % tile == 0
+    assert vb.step_footprint_bytes(1, tile) <= foot64 - 1
+
+
+def test_pick_tile_rows_rejects_impossible_budget():
+    with pytest.raises(ValueError, match="scoped VMEM"):
+        vb.pick_tile_rows(5, 64, budget=1024)
+    with pytest.raises(ValueError, match="multiple"):
+        vb.pick_tile_rows(1, 12)
+
+
+def test_budget_env_override(monkeypatch):
+    monkeypatch.setenv("CHARON_TPU_VMEM_BUDGET_MB", "15.5")
+    assert vb.budget_bytes() == int(15.5 * 1024 * 1024)
+    monkeypatch.delenv("CHARON_TPU_VMEM_BUDGET_MB", raising=False)
+    assert vb.budget_bytes() == int(vb.DEFAULT_BUDGET_MB * 1024 * 1024)
+
+
+def test_budget_env_over_hard_limit_rejected(monkeypatch):
+    """A budget the compiler cannot honor must fail fast at the knob, not
+    at TPU AOT compile (pick_tile_rows' error suggests raising the env —
+    following that advice past 16 MiB would re-create the r05 OOM)."""
+    monkeypatch.setenv("CHARON_TPU_VMEM_BUDGET_MB", "18")
+    with pytest.raises(ValueError, match="hard limit"):
+        vb.budget_bytes()
+    monkeypatch.setenv("CHARON_TPU_VMEM_BUDGET_MB", "16")
+    assert vb.budget_bytes() == vb.HARD_LIMIT_BYTES
+
+
+def test_layout_constants_match_pallas_g2():
+    """The budget model duplicates layout constants so it stays
+    import-light; pallas_g2 asserts them at import time too, but pin the
+    cross-check here where a drift is reported with a name."""
+    pallas_g2 = pytest.importorskip("charon_tpu.ops.pallas_g2")
+    assert vb.NLIMBS == pallas_g2.NL
+    assert vb.LANES == pallas_g2.LANES
+    assert vb.SUBLANES == pallas_g2.SUBLANES
+    assert vb.FC_ROWS == pallas_g2._FC_ROWS
