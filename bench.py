@@ -43,6 +43,33 @@ def _enable_compile_cache():
         pass
 
 
+def _preflight_audit(v: int, t: int) -> None:
+    """Kernel contract preflight (charon_tpu.analysis): trace-audit the
+    kernels of the active MSM path at THIS bench's (V, T) shape and
+    refuse to start against an unauditable kernel set.  The round-5 bench
+    burned a full TPU session discovering at AOT-compile time that its
+    kernel needed 17.48 MiB of scoped VMEM; the same violation is now a
+    preflight error before any device work.  CHARON_TPU_PREFLIGHT=0
+    skips (e.g. when iterating on a knowingly-dirty kernel)."""
+    if os.environ.get("CHARON_TPU_PREFLIGHT", "1") == "0":
+        return
+    from charon_tpu.analysis.audit import run_audit
+
+    kind = os.environ.get("CHARON_TPU_MSM", "straus")
+    trace = kind if kind in ("straus", "dblsel") else "all"
+    report = run_audit(shapes=[(v, t)], trace=trace, shard=False)
+    if not report.ok:
+        print(report.summary(), file=sys.stderr)
+        print(json.dumps({
+            "error": "kernel contract audit failed — refusing to bench",
+            "violations": report.violations,
+        }))
+        sys.exit(2)
+    print(f"preflight: kernel contract audit PASS "
+          f"({len(report.kernels)} kernels at V={v} T={t})",
+          file=sys.stderr)
+
+
 def main() -> None:
     _enable_compile_cache()
     import numpy as np
@@ -59,6 +86,7 @@ def main() -> None:
     V = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     T = int(sys.argv[2]) if len(sys.argv) > 2 else 7      # 7-of-10
     REPS = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    _preflight_audit(V, T)
     rng = np.random.default_rng(20260729)
 
     api.set_scheme("bls")

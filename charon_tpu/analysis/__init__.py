@@ -1,0 +1,47 @@
+"""Kernel contract auditor — trace-time static analysis for the TPU paths.
+
+Rounds 4 and 5 both shipped default-on TPU code that was broken on real
+hardware — a 17.48 MiB scoped-VMEM overflow and a `shard_map` fori_loop
+carry-type mismatch — because the CPU tier-1 suite structurally cannot
+observe either bug class.  This subsystem closes that gap with three
+passes that need no TPU attached:
+
+1. **Kernel registry + jaxpr audit** (`jaxpr_audit`): `ops/pallas_g2`
+   and `ops/pallas_fp` register every Pallas kernel with its declared
+   workload shapes; the auditor traces each kernel and walks the kernel
+   body jaxpr asserting dtype discipline (limb math stays int32/uint32,
+   no silent promotion to float, no transcendental or host-callback
+   primitives in crypto kernels) and grid/BlockSpec divisibility.
+2. **VMEM reconciliation** (`vmem_audit`): the per-kernel scoped-VMEM
+   footprint is derived from the *actual BlockSpecs* of the traced
+   pallas call (double-buffered revolving blocks, single-buffered
+   grid-invariant blocks, the calibrated value-stack term) and
+   cross-checked against the `ops/vmem_budget` model — drift beyond a
+   tolerance, or a footprint over the budget/hard limit, is an error.
+   The round-5 "comment says 9.4 MB, compiler says 17.48 MB" failure
+   becomes a trace-time error.
+3. **Shard-carry check** (`shard_audit`): `tbls/backend_tpu`'s
+   shard_map programs are re-traced on a virtual CPU mesh and every
+   fori_loop/scan carry is checked for the round-5 `pvary` bug class —
+   a replicated (device-invariant) carry init whose body output is
+   device-varying.
+
+Run it as ``python -m charon_tpu.analysis`` (exit 0 iff every contract
+holds), as a tier-1 test (tests/test_static_analysis.py), as the
+`bench.py` preflight gate, and inside `__graft_entry__.dryrun_multichip`.
+
+This package's ``__init__`` stays import-light on purpose: the ops
+modules import `analysis.registry` at import time to register their
+kernels, so importing the audit passes here would be circular.
+"""
+
+from __future__ import annotations
+
+from . import registry  # noqa: F401  (the import-light registration API)
+
+
+def run_audit(*args, **kwargs):
+    """Lazy forwarder to :func:`charon_tpu.analysis.audit.run_audit`."""
+    from .audit import run_audit as _run
+
+    return _run(*args, **kwargs)

@@ -1,0 +1,93 @@
+"""``python -m charon_tpu.analysis`` — the kernel contract auditor CLI.
+
+Exit status 0 iff every registered kernel and shard program honors its
+contract (dtype discipline, grid/BlockSpec invariants, scoped-VMEM
+budget reconciliation, shard-carry discipline).  ``--golden-bad`` audits
+a known-broken fixture instead and therefore exits non-zero — the
+driver-level proof that the auditor actually detects the round-5 bug
+classes, not just that HEAD is clean.
+
+Needs no TPU: kernels are traced (never executed) and the shard pass
+runs on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m charon_tpu.analysis",
+        description="Trace-time kernel contract auditor (no TPU needed)")
+    ap.add_argument("--golden-bad",
+                    choices=["r05_vmem", "replicated_carry", "float_leak"],
+                    help="audit a known-broken fixture instead of HEAD "
+                         "(expected exit status: non-zero)")
+    ap.add_argument("--trace", default="all",
+                    choices=["all", "straus", "dblsel", "none"],
+                    help="which kernels get the expensive traced passes "
+                         "(grid arithmetic always covers all)")
+    ap.add_argument("--no-shard", action="store_true",
+                    help="skip the shard-carry pass")
+    ap.add_argument("--shapes", default=None,
+                    help="comma-separated VxT list overriding the "
+                         "registered workload shapes, e.g. 10000x7,1024x2")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU devices for the shard pass")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full structured report as JSON")
+    args = ap.parse_args(argv)
+
+    # The audit needs no accelerator; force CPU (the dev environment
+    # pre-sets JAX_PLATFORMS=axon — same override as tests/conftest.py)
+    # so it runs the same everywhere — and the virtual-device flag must
+    # be in the environment BEFORE jax initialises a backend (XLA parses
+    # XLA_FLAGS once per process; see __graft_entry__.dryrun_multichip).
+    if os.environ.get("CHARON_TPU_TEST_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count"
+                    f"={args.devices}").strip()
+    elif int(m.group(1)) < args.devices:
+        # a smaller pre-existing count (e.g. a stale dev shell) would
+        # silently weaken the shard pass — raise it to the request
+        os.environ["XLA_FLAGS"] = (
+            flags[:m.start()]
+            + f"--xla_force_host_platform_device_count={args.devices}"
+            + flags[m.end():])
+
+    if args.golden_bad:
+        from .fixtures import audit_golden_bad
+
+        report = audit_golden_bad(args.golden_bad)
+        print(f"--golden-bad {args.golden_bad} (expected: FAIL)")
+    else:
+        from .audit import run_audit
+
+        shapes = None
+        if args.shapes:
+            shapes = [tuple(int(x) for x in part.split("x"))
+                      for part in args.shapes.split(",")]
+        report = run_audit(shapes=shapes, trace=args.trace,
+                           shard=not args.no_shard, n_dev=args.devices)
+
+    if args.json:
+        # stdout stays parseable JSON; the human summary goes to stderr
+        print(json.dumps(report.to_dict(), indent=2, default=str))
+        print(report.summary(), file=sys.stderr)
+    else:
+        print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,301 @@
+"""Audit orchestration: run the three passes over the kernel registry.
+
+`run_audit` is the single entry point used by the CLI
+(``python -m charon_tpu.analysis``), the tier-1 suite
+(tests/test_static_analysis.py), the `bench.py` preflight gate, and
+`__graft_entry__.dryrun_multichip`.
+
+Cost model: tracing a fused group-law kernel body is expensive (the
+unrolled Mosaic form is ~20k-100k primitives, tens of seconds each), so
+the jaxpr/VMEM passes trace each kernel ONCE, at its smallest budgeted
+tile with a one-step grid — the kernel body jaxpr and the BlockSpec
+layout per grid step are identical at every S, only the grid count
+changes, and the grid arithmetic is checked exactly for every registered
+workload shape without tracing.  Traced jaxprs are cached per
+(kernel, tile) for the life of the process so the tier-1 test, the
+bench preflight, and repeated CLI calls in one process pay each trace
+once.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import asdict, dataclass, field
+
+from . import registry
+from .jaxpr_audit import audit_kernel_body
+from .vmem_audit import (audit_footprint, check_block_divisibility,
+                         find_single_pallas_call)
+
+#: Kernel-name subsets for the `trace` knob: the bench preflight traces
+#: only the kernels of the active MSM path, the full audit traces all.
+TRACE_SETS = {
+    "straus": ("pallas_g2.dbl", "pallas_g2.add", "pallas_g2.addsel_s",
+               "pallas_g2.dbl3sel_s"),
+    "dblsel": ("pallas_g2.dbl", "pallas_g2.add", "pallas_g2.addsel",
+               "pallas_g2.dblsel"),
+}
+
+# process-lifetime cache: (kernel name, tile rows) -> closed jaxpr
+_TRACE_CACHE: dict = {}
+
+
+@dataclass
+class KernelAudit:
+    name: str
+    family: str
+    s_rows_checked: list = field(default_factory=list)
+    tiles: dict = field(default_factory=dict)       # s_rows -> tile
+    traced_tile: int | None = None
+    body_eqns: int | None = None
+    trace_seconds: float | None = None
+    derived_bytes: int | None = None
+    model_bytes: int | None = None
+    drift_bytes: int | None = None
+    violations: list = field(default_factory=list)
+
+
+@dataclass
+class AuditReport:
+    kernels: list = field(default_factory=list)
+    shard_cases: list = field(default_factory=list)
+    shapes_checked: list = field(default_factory=list)
+
+    @property
+    def violations(self) -> list:
+        out = []
+        for k in self.kernels:
+            out += k.violations
+        for s in self.shard_cases:
+            out += s.violations
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "shapes_checked": self.shapes_checked,
+            "kernels": [asdict(k) for k in self.kernels],
+            "shard_cases": [asdict(s) for s in self.shard_cases],
+            "violations": self.violations,
+        }
+
+    def summary(self) -> str:
+        lines = []
+        for k in self.kernels:
+            foot = ""
+            if k.derived_bytes is not None:
+                drift = (f", drift {k.drift_bytes} B"
+                         if k.drift_bytes is not None else "")
+                foot = (f" vmem {k.derived_bytes / 2**20:.2f} MiB"
+                        f"{drift}")
+            traced = (f" traced@tile={k.traced_tile} "
+                      f"({k.body_eqns} eqns, {k.trace_seconds:.1f}s)"
+                      if k.traced_tile is not None else " (arith only)")
+            verdict = "ok" if not k.violations else "FAIL"
+            lines.append(f"  [{verdict}] {k.name}: "
+                         f"S∈{sorted(set(k.s_rows_checked))}{foot}{traced}")
+        for s in self.shard_cases:
+            verdict = "ok" if not s.violations else "FAIL"
+            lines.append(f"  [{verdict}] {s.name}: "
+                         f"{s.carries_checked} loop carries checked")
+        for v in self.violations:
+            lines.append(f"  VIOLATION: {v}")
+        status = "PASS" if self.ok else "FAIL"
+        lines.append(f"kernel contract audit: {status} "
+                     f"({len(self.kernels)} kernels, "
+                     f"{len(self.shard_cases)} shard cases, "
+                     f"{len(self.violations)} violations)")
+        return "\n".join(lines)
+
+
+def _trace_kernel(spec: registry.KernelSpec, tile: int):
+    import jax
+
+    key = (spec.name, tile)
+    if key not in _TRACE_CACHE:
+        t0 = time.perf_counter()
+        jaxpr = jax.make_jaxpr(spec.build(tile))(*spec.make_args(tile))
+        _TRACE_CACHE[key] = (jaxpr.jaxpr, time.perf_counter() - t0)
+    return _TRACE_CACHE[key]
+
+
+def audit_kernel(spec: registry.KernelSpec, s_rows_list, *,
+                 trace: bool = True, tolerance=None) -> KernelAudit:
+    """Arithmetic checks for every S in `s_rows_list` plus (optionally)
+    the traced jaxpr/VMEM passes at the smallest budgeted tile."""
+    from ..ops import vmem_budget as vb
+    from .vmem_audit import DEFAULT_TOLERANCE_BYTES
+
+    if tolerance is None:
+        tolerance = DEFAULT_TOLERANCE_BYTES
+    audit = KernelAudit(name=spec.name, family=spec.family)
+    budget = vb.budget_bytes()
+    for s_rows in sorted(set(s_rows_list)):
+        audit.s_rows_checked.append(s_rows)
+        if s_rows % vb.SUBLANES:
+            audit.violations.append(
+                f"{spec.name}: S={s_rows} rows not on the "
+                f"{vb.SUBLANES}-sublane grid")
+            continue
+        if spec.family == "g2":
+            try:
+                tile = vb.pick_tile_rows(spec.n_point_inputs, s_rows,
+                                         with_digits=spec.with_digits,
+                                         budget=budget)
+            except ValueError as exc:
+                audit.violations.append(f"{spec.name} at S={s_rows}: {exc}")
+                continue
+        else:
+            tile = vb.SUBLANES
+        audit.tiles[s_rows] = tile
+        if s_rows % tile:
+            audit.violations.append(
+                f"{spec.name}: tile {tile} does not grid S={s_rows}")
+
+    if not trace or not audit.tiles:
+        return audit
+
+    import jax  # noqa: F401  (tracing below)
+
+    tile0 = min(audit.tiles.values())
+    try:
+        body_owner, secs = _trace_kernel(spec, tile0)
+    except Exception as exc:  # noqa: BLE001 — a kernel that cannot trace
+        audit.violations.append(
+            f"{spec.name}: tracing at tile={tile0} failed: "
+            f"{type(exc).__name__}: {exc}")
+        return audit
+    audit.traced_tile = tile0
+    audit.trace_seconds = secs
+
+    eqn, errs = find_single_pallas_call(body_owner, spec.name)
+    audit.violations += errs
+    if eqn is None:
+        return audit
+    body = eqn.params["jaxpr"]
+    gm = eqn.params["grid_mapping"]
+    audit.body_eqns = len(body.eqns)
+
+    audit.violations += audit_kernel_body(body, spec.name)
+    audit.violations += check_block_divisibility(gm, spec.name)
+    foot = audit_footprint(
+        gm, spec.name, n_point_inputs=spec.n_point_inputs,
+        with_digits=spec.with_digits, reconcile=spec.reconcile_budget,
+        tolerance=tolerance, budget=budget)
+    audit.derived_bytes = foot.derived_bytes
+    audit.model_bytes = foot.model_bytes
+    audit.drift_bytes = foot.drift_bytes
+    audit.violations += foot.violations
+    if foot.tile_rows != tile0:
+        audit.violations.append(
+            f"{spec.name}: traced revolving blocks carry {foot.tile_rows} "
+            f"rows but the budget model picked tile={tile0} — the builder "
+            f"is not sizing its tiles from ops/vmem_budget")
+    return audit
+
+
+def _shape_s_rows(family: str, shapes=None):
+    """s_rows per (V, T): from explicit shapes via the backend's padding
+    arithmetic, else from the registered workload shapes."""
+    out: dict[int, list] = {}
+    if shapes is None:
+        for ws in registry.workload_shapes(family):
+            out.setdefault(ws.s_rows, []).append((ws.v, ws.t, ws.origin))
+    else:
+        from ..tbls import backend_tpu
+
+        for v, t in shapes:
+            for origin, s_rows in backend_tpu.audit_s_rows(v, t).items():
+                out.setdefault(s_rows, []).append((v, t, origin))
+    return out
+
+
+def run_audit(shapes=None, trace: str = "all", shard: bool = True,
+              n_dev: int | None = None, tolerance=None,
+              shard_retrace: bool = True) -> AuditReport:
+    """Run the kernel contract audit.
+
+    shapes : optional [(V, T), ...] overriding the registered workload
+             shapes (the bench preflight audits its own shape).
+    trace  : "all" | "straus" | "dblsel" | "none" — which kernels get the
+             expensive traced passes; grid arithmetic always covers all.
+    shard  : run the shard-carry pass over the registered shard_map
+             programs on the local device mesh.
+    shard_retrace : also re-trace each shard program with replication
+             checking on (see shard_audit.audit_shard_case).
+    """
+    registry.ensure_populated()
+    report = AuditReport()
+
+    s_rows_map = _shape_s_rows("g2", shapes)
+    report.shapes_checked = sorted(
+        {(v, t) for rows in s_rows_map.values() for (v, t, _) in rows})
+    trace_names = (set() if trace == "none" else
+                   set(TRACE_SETS.get(trace, ())) if trace in TRACE_SETS
+                   else None)  # None: trace everything
+
+    for spec in registry.kernels():
+        if spec.family == "g2":
+            s_rows_list = list(s_rows_map)
+        else:
+            # fp kernels tile a fixed [NLIMBS, 8, 128] block; audit the
+            # 1-tile and many-tile grids
+            s_rows_list = [8, 1024]
+        do_trace = trace_names is None or spec.name in trace_names
+        # fp kernel bodies are cheap to trace; include them whenever any
+        # tracing is requested
+        if trace != "none" and spec.family == "fp":
+            do_trace = True
+        report.kernels.append(
+            audit_kernel(spec, s_rows_list, trace=do_trace,
+                         tolerance=tolerance))
+
+    if shard:
+        report.shard_cases += run_shard_audit(n_dev=n_dev,
+                                              retrace=shard_retrace)
+    return report
+
+
+@contextlib.contextmanager
+def shard_audit_env(n_dev: int | None = None, direct=None):
+    """Mesh + kernel-mode context for the shard pass: a "dp" mesh over
+    the local devices, with pallas_g2.DIRECT set for a CPU-mesh trace
+    (the collapsed kernel math) unless the default backend is a real
+    TPU.  One copy shared by the production audit and the golden-bad
+    fixture runner so both always trace under the same configuration."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..ops import pallas_g2
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:min(n_dev or 8, len(devices))]), ("dp",))
+    use_direct = (direct if direct is not None
+                  else jax.default_backend() != "tpu")
+    prev = pallas_g2.DIRECT
+    pallas_g2.DIRECT = use_direct
+    try:
+        yield mesh
+    finally:
+        pallas_g2.DIRECT = prev
+
+
+def run_shard_audit(n_dev: int | None = None, direct=None,
+                    retrace: bool = True) -> list:
+    """Pass 3 over every registered shard program."""
+    from .shard_audit import audit_shard_case
+
+    registry.ensure_populated()
+    out = []
+    with shard_audit_env(n_dev, direct) as mesh:
+        for spec in registry.shard_programs():
+            for (t, nwin) in spec.cases:
+                out.append(audit_shard_case(spec, mesh, t, nwin,
+                                            retrace=retrace))
+    return out

@@ -1,0 +1,185 @@
+"""Golden-bad fixtures: known-broken kernel/program layouts the auditor
+MUST flag.  Each re-creates one of the round-5 hardware-only failures in
+miniature so the audit's detection of that bug class is itself pinned by
+tier-1 (tests/test_static_analysis.py) and demonstrable from the CLI
+(``python -m charon_tpu.analysis --golden-bad ...`` exits non-zero).
+
+- `r05_vmem`: the round-5 scoped-VMEM OOM layout.  The fold-constant
+  table enters the kernel broadcast to full vreg shape
+  [FC_ROWS, NLIMBS, 8, 128] (4.5 MiB) next to the 12 revolving point
+  blocks of the deepest Straus kernel — per-grid-step footprint
+  ≈17.9 MiB against the 16 MiB hard limit, which is what the Mosaic
+  compiler reported (17.48 MiB) when the bench died at AOT compile.
+  The kernel BODY here is thin on purpose: the footprint model's stack
+  term is calibrated per row, not per primitive, so the audited numbers
+  depend only on the BlockSpec layout being re-created — tracing a
+  100k-primitive body would add a minute of test time and nothing else.
+
+- `replicated_carry`: the round-5 shard_map carry mismatch.  The same
+  per-device Straus combine body the production path uses, but the
+  fori_loop accumulator is initialised from the replicated ∞ constant
+  instead of `backend_tpu._varying_inf_tiled`'s device-varying form —
+  exactly the code round 5 shipped.
+
+- `float_leak`: a kernel whose body silently promotes limb math to
+  float32 and calls a transcendental — the dtype-discipline pass must
+  flag both.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import registry
+
+
+def r05_vmem_kernel_spec() -> registry.KernelSpec:
+    """The r05 over-limit layout as a registrable KernelSpec (NOT put in
+    the global registry — the auditor is pointed at it explicitly)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pallas_g2 as pg
+
+    def build(s_rows: int, interpret: bool = True):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        tile = 8  # r05 ran the minimum tile and still blew the limit
+
+        def kernel(fc_ref, acc_ref, t1_ref, t2_ref, t3_ref, t4_ref,
+                   w_ref, o_ref):
+            # thin body: the select/keep skeleton only (see module doc)
+            w = w_ref[...][None, None, :, :]
+            o_ref[...] = jnp.where(w == 0, acc_ref[...], t1_ref[...])
+
+        pt_spec = pl.BlockSpec((6, pg.NL, tile, pg.LANES),
+                               lambda i: (0, 0, i, 0),
+                               memory_space=pltpu.VMEM)
+        # THE BUG: fold constants at full vreg broadcast — 4.5 MiB of the
+        # 16 MiB scoped-VMEM space for a table that needs 576 KiB
+        fc_spec = pl.BlockSpec((pg._FC_ROWS, pg.NL, 8, pg.LANES),
+                               lambda i: (0, 0, 0, 0),
+                               memory_space=pltpu.VMEM)
+        w_spec = pl.BlockSpec((tile, pg.LANES), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=(s_rows // tile,),
+            in_specs=[fc_spec] + [pt_spec] * 5 + [w_spec],
+            out_specs=pt_spec,
+            out_shape=jax.ShapeDtypeStruct((6, pg.NL, s_rows, pg.LANES),
+                                           jnp.int32),
+            interpret=interpret,
+        )
+
+    def make_args(s_rows: int) -> tuple:
+        import jax
+
+        from ..ops import pallas_g2 as pg
+
+        i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
+        pt = i32(6, pg.NL, s_rows, pg.LANES)
+        return ((i32(pg._FC_ROWS, pg.NL, 8, pg.LANES),)
+                + (pt,) * 5 + (i32(s_rows, pg.LANES),))
+
+    return registry.KernelSpec(
+        name="golden_bad.r05_fold_constant_broadcast", family="g2",
+        n_point_inputs=5, with_digits=True, build=build,
+        make_args=make_args)
+
+
+def float_leak_kernel_spec() -> registry.KernelSpec:
+    """A kernel that promotes limbs to float32 and takes a sqrt."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pallas_g2 as pg
+
+    def build(s_rows: int, interpret: bool = True):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(a_ref, o_ref):
+            x = a_ref[...].astype(jnp.float32)
+            o_ref[...] = jnp.sqrt(x).astype(jnp.int32)
+
+        spec = pl.BlockSpec((6, pg.NL, 8, pg.LANES), lambda i: (0, 0, i, 0),
+                            memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel, grid=(s_rows // 8,), in_specs=[spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((6, pg.NL, s_rows, pg.LANES),
+                                           jnp.int32),
+            interpret=interpret)
+
+    def make_args(s_rows: int) -> tuple:
+        import jax
+
+        return (jax.ShapeDtypeStruct((6, pg.NL, s_rows, pg.LANES),
+                                     np.int32),)
+
+    return registry.KernelSpec(
+        name="golden_bad.float_leak", family="g2", n_point_inputs=1,
+        with_digits=False, build=build, make_args=make_args,
+        reconcile_budget=False)
+
+
+def replicated_carry_shard_spec() -> registry.ShardProgramSpec:
+    """The r05 sharded combine: fori_loop accumulator initialised from
+    the replicated ∞ constant (no pvary, no data dependence on the
+    mapped operands) — the exact carry the round-5 dry run died on."""
+    import jax.numpy as jnp
+
+    from ..ops import pallas_g2
+
+    def build_local(t: int, nwin: int):
+        def local(p, d):
+            vl = p.shape[0]
+            rows = p.transpose(1, 0, 2, 3, 4).reshape(
+                vl * t, 3, 2, p.shape[-1])
+            digits = d.transpose(2, 1, 0).reshape(nwin, (t * vl) // 128, 128)
+            fc = jnp.asarray(pallas_g2.fold_consts())
+            # THE BUG: replicated constant carry init (round-5 code)
+            acc0 = pallas_g2.inf_tiled(vl // 128)
+            out = pallas_g2.straus_combine(fc, pallas_g2.tile_points(rows),
+                                           digits, t, acc0=acc0)
+            return pallas_g2.untile_points(out)
+
+        return local
+
+    from ..tbls import backend_tpu
+
+    return registry.ShardProgramSpec(
+        name="golden_bad.replicated_carry",
+        build_local=build_local,
+        make_global_args=backend_tpu.shard_audit_args,
+        cases=((2, backend_tpu.STRAUS_NWIN),))
+
+
+def audit_golden_bad(which: str):
+    """Audit one golden-bad fixture; the returned report must NOT be ok."""
+    from .audit import AuditReport, audit_kernel
+
+    registry.ensure_populated()
+    report = AuditReport()
+    if which == "r05_vmem":
+        report.kernels.append(
+            audit_kernel(r05_vmem_kernel_spec(), [8], trace=True))
+    elif which == "float_leak":
+        report.kernels.append(
+            audit_kernel(float_leak_kernel_spec(), [8], trace=True))
+    elif which == "replicated_carry":
+        from .audit import shard_audit_env
+        from .shard_audit import audit_shard_case
+
+        spec = replicated_carry_shard_spec()
+        with shard_audit_env() as mesh:
+            for (t, nwin) in spec.cases:
+                # retrace=False: on JAX without varying-axis tracking the
+                # check_rep rewrite silently repairs the replicated carry
+                # — the static taint pass is the detector here
+                report.shard_cases.append(
+                    audit_shard_case(spec, mesh, t, nwin, retrace=False))
+    else:
+        raise ValueError(f"unknown golden-bad fixture {which!r}")
+    return report

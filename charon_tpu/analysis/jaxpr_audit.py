@@ -1,0 +1,161 @@
+"""Jaxpr-walking primitives shared by the audit passes.
+
+Pass 1 of the auditor: trace a registered kernel, find its pallas_call
+equation, and walk the kernel-body jaxpr asserting the crypto-kernel
+dtype discipline — every value stays in the integer/boolean domain (limb
+math is int32/uint32; comparisons and selects produce bools) and no
+transcendental, floating-point-only, or host-callback primitive appears.
+A silent promotion to float (the classic jnp footgun: a Python float
+literal, a mean(), a true-divide) would make the redundant-residue field
+arithmetic silently wrong on TPU while CPU tests that compare against a
+float-tolerant oracle could stay green; a host callback inside a kernel
+cannot lower to Mosaic at all and would only fail at TPU compile time.
+
+Also home to the conservative taint (data-dependence) propagation the
+shard-carry checker and the BlockSpec grid-invariance classifier build
+on: a variable is tainted iff it is data-dependent on a tainted input,
+where "marking" primitives (pvary/pbroadcast and friends on JAX versions
+that have them) taint their outputs unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator
+
+from jax import core as jcore
+
+# Dtypes permitted inside a crypto kernel body.  Limb math is int32 (the
+# 12-bit redundant-residue design of ops/fp) with uint32 allowed for bit
+# twiddling; bool comes from comparisons/selects; the narrow ints cover
+# window/digit planes.  Any float/complex dtype is a contract violation.
+ALLOWED_KERNEL_DTYPES = frozenset({
+    "int8", "int16", "int32", "uint8", "uint16", "uint32", "bool",
+})
+
+# Primitives that must never appear in a crypto kernel body: everything
+# transcendental/float-only (these imply a silent promotion even if the
+# result is cast back) and every host-callback/infeed escape hatch (they
+# cannot lower inside a Mosaic kernel).
+FORBIDDEN_KERNEL_PRIMS = frozenset({
+    # transcendental / float-only math
+    "exp", "exp2", "expm1", "log", "log2", "log1p", "logistic",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "sqrt", "rsqrt", "cbrt", "pow", "erf", "erfc", "erf_inv",
+    "lgamma", "digamma", "igamma", "igammac", "polygamma",
+    "bessel_i0e", "bessel_i1e", "regularized_incomplete_beta",
+    "nextafter", "round", "is_finite",
+    # host callbacks / IO escape hatches
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+})
+
+# Primitives whose outputs are device-varying by fiat: the explicit
+# replication-adjustment markers of shard_map.  `pvary` is the newer-JAX
+# spelling of the round-5 fix; `pbroadcast` is what this JAX's check_rep
+# rewrite inserts (the carry checker traces with check_rep=False exactly
+# so that auto-inserted pbroadcasts cannot mask an unmarked carry, but a
+# SOURCE-level pbroadcast still counts as marked).  Collectives produce
+# per-device results, so they count too.
+MARK_VARYING_PRIMS = frozenset({
+    "pvary", "pbroadcast", "psum", "pmax", "pmin", "ppermute",
+    "all_gather", "all_to_all", "reduce_scatter", "axis_index",
+})
+
+
+def _as_jaxpr(obj: Any) -> jcore.Jaxpr | None:
+    if isinstance(obj, jcore.Jaxpr):
+        return obj
+    if isinstance(obj, jcore.ClosedJaxpr):
+        return obj.jaxpr
+    return None
+
+
+def sub_jaxprs(eqn: jcore.JaxprEqn) -> Iterator[jcore.Jaxpr]:
+    """Every jaxpr nested in an equation's params (call bodies, scan/while
+    bodies, cond branches, pallas kernel bodies, ...)."""
+    for val in eqn.params.values():
+        got = _as_jaxpr(val)
+        if got is not None:
+            yield got
+        elif isinstance(val, (tuple, list)):
+            for item in val:
+                got = _as_jaxpr(item)
+                if got is not None:
+                    yield got
+
+
+def walk_eqns(jaxpr: jcore.Jaxpr) -> Iterator[jcore.JaxprEqn]:
+    """All equations of a jaxpr, recursively through nested jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn):
+            yield from walk_eqns(sub)
+
+
+def find_eqns(jaxpr: jcore.Jaxpr, prim_name: str) -> list[jcore.JaxprEqn]:
+    return [e for e in walk_eqns(jaxpr) if e.primitive.name == prim_name]
+
+
+def audit_kernel_body(body: jcore.Jaxpr, kernel_name: str) -> list[str]:
+    """Dtype-discipline and forbidden-primitive violations of one kernel
+    body jaxpr (recursive; a kernel body may contain inner scans)."""
+    violations: list[str] = []
+    bad_dtypes: dict[str, str] = {}
+    bad_prims: dict[str, int] = {}
+    for eqn in walk_eqns(body):
+        name = eqn.primitive.name
+        if name in FORBIDDEN_KERNEL_PRIMS:
+            bad_prims[name] = bad_prims.get(name, 0) + 1
+        for var in eqn.outvars:
+            aval = var.aval
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and str(dtype) not in ALLOWED_KERNEL_DTYPES:
+                bad_dtypes.setdefault(str(dtype), name)
+    for dtype, prim in sorted(bad_dtypes.items()):
+        violations.append(
+            f"{kernel_name}: kernel body produces dtype {dtype} "
+            f"(first at primitive '{prim}'); crypto kernels must stay in "
+            f"{sorted(ALLOWED_KERNEL_DTYPES)}")
+    for name, count in sorted(bad_prims.items()):
+        violations.append(
+            f"{kernel_name}: forbidden primitive '{name}' appears "
+            f"{count}x in the kernel body (transcendental/host-callback "
+            f"ops cannot appear in crypto kernels)")
+    return violations
+
+
+def propagate_taint(jaxpr: jcore.Jaxpr,
+                    invar_taint: Iterable[bool]) -> dict[jcore.Var, bool]:
+    """Conservative forward data-dependence pass over one jaxpr level.
+
+    Returns the taint state of every variable bound in the jaxpr.  An
+    equation output is tainted if any input is tainted or the primitive
+    is a varying-marker.  Nested jaxprs are NOT entered — a call-like
+    equation simply propagates taint conservatively — which is exact
+    enough for carry checking (the checker descends into scan/while
+    bodies itself, where precision matters)."""
+    taint: dict[jcore.Var, bool] = {}
+    for var, is_t in zip(jaxpr.invars, invar_taint):
+        taint[var] = bool(is_t)
+    for var in jaxpr.constvars:
+        taint[var] = False
+
+    def var_taint(v) -> bool:
+        if isinstance(v, jcore.Literal):
+            return False
+        return taint.get(v, False)
+
+    for eqn in jaxpr.eqns:
+        out_t = (eqn.primitive.name in MARK_VARYING_PRIMS
+                 or any(var_taint(v) for v in eqn.invars))
+        for var in eqn.outvars:
+            taint[var] = out_t
+    return taint
+
+
+def outvar_taint(jaxpr: jcore.Jaxpr,
+                 invar_taint: Iterable[bool]) -> list[bool]:
+    taint = propagate_taint(jaxpr, invar_taint)
+    return [False if isinstance(v, jcore.Literal) else taint.get(v, False)
+            for v in jaxpr.outvars]

@@ -1,0 +1,107 @@
+"""Kernel-contract registry — the import-light registration API.
+
+`ops/pallas_g2` and `ops/pallas_fp` register every Pallas kernel here at
+import time, together with builders the auditor can use to construct a
+traceable call at any S size; `tbls/backend_tpu` registers the workload
+shapes its combine paths actually emit (including the V=10k/T=7 bench
+shape) and its shard_map programs.  The audit passes then iterate the
+registry — a kernel that is not registered is itself an audit failure
+(tests/test_static_analysis.py pins the expected population).
+
+This module deliberately imports neither jax nor numpy so registration
+adds nothing to the import cost of the ops modules and cannot create
+import cycles (ops → analysis.registry ← analysis.audit → ops).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered Pallas kernel.
+
+    ``build(s_rows)`` returns a traceable callable (the pl.pallas_call
+    wrapper) for an S of ``s_rows`` rows; ``make_args(s_rows)`` returns
+    matching ``jax.ShapeDtypeStruct`` arguments.  ``n_point_inputs`` and
+    ``with_digits`` mirror the `ops/vmem_budget` model parameters for the
+    VMEM reconciliation pass; ``reconcile_budget`` is False for families
+    the calibrated model does not cover (they still get the dtype, grid,
+    and budget-ceiling checks)."""
+
+    name: str                           # e.g. "pallas_g2.dbl3sel_s"
+    family: str                         # "g2" | "fp"
+    n_point_inputs: int
+    with_digits: bool
+    build: Callable[[int], Callable[..., Any]]
+    make_args: Callable[[int], tuple]
+    reconcile_budget: bool = True
+
+
+@dataclass(frozen=True)
+class WorkloadShape:
+    """One (V, T) shape a backend combine path emits, as kernel S rows."""
+
+    family: str
+    v: int
+    t: int
+    s_rows: int
+    origin: str                         # "fused" | "sharded"
+
+
+@dataclass(frozen=True)
+class ShardProgramSpec:
+    """One shard_map program family of the backend.
+
+    ``build_local(t, nwin)`` returns the per-device local function (the
+    body `shard_map` wraps); ``make_global_args(n_dev, t, nwin)`` returns
+    global-shape ``jax.ShapeDtypeStruct`` args, all sharded on the mesh's
+    "dp" axis at axis 0.  ``cases`` lists the (t, nwin) instantiations to
+    audit."""
+
+    name: str
+    build_local: Callable[[int, int], Callable[..., Any]]
+    make_global_args: Callable[[int, int, int], tuple]
+    cases: tuple = ()
+
+
+_KERNELS: dict[str, KernelSpec] = {}
+_SHAPES: dict[tuple, WorkloadShape] = {}
+_SHARD_PROGRAMS: dict[str, ShardProgramSpec] = {}
+
+
+def register_kernel(spec: KernelSpec) -> None:
+    _KERNELS[spec.name] = spec
+
+
+def register_workload_shape(shape: WorkloadShape) -> None:
+    _SHAPES[(shape.family, shape.v, shape.t, shape.origin)] = shape
+
+
+def register_shard_program(spec: ShardProgramSpec) -> None:
+    _SHARD_PROGRAMS[spec.name] = spec
+
+
+def kernels() -> tuple[KernelSpec, ...]:
+    return tuple(_KERNELS[k] for k in sorted(_KERNELS))
+
+
+def workload_shapes(family: str | None = None) -> tuple[WorkloadShape, ...]:
+    out = [s for s in _SHAPES.values() if family is None or s.family == family]
+    return tuple(sorted(out, key=lambda s: (s.family, s.v, s.t, s.origin)))
+
+
+def shard_programs() -> tuple[ShardProgramSpec, ...]:
+    return tuple(_SHARD_PROGRAMS[k] for k in sorted(_SHARD_PROGRAMS))
+
+
+def ensure_populated() -> None:
+    """Import the modules that register kernels/shapes/programs.
+
+    Import-light callers (the CLI, tests) call this once before reading
+    the registry; the imports are no-ops when already loaded."""
+    from ..ops import pallas_fp  # noqa: F401
+    from ..ops import pallas_g2  # noqa: F401
+    from ..tbls import backend_tpu  # noqa: F401

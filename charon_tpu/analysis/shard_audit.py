@@ -1,0 +1,200 @@
+"""Shard-carry checker — pass 3 of the kernel contract auditor.
+
+Round 5 broke `straus_combine` under shard_map with a fori_loop carry
+whose init was a replicated constant (the ∞ accumulator) while the loop
+body produced a device-varying point batch: newer JAX tracks varying
+manual axes on loop carries and refuses to unify the two ("pvary" carry
+mismatch).  Older JAX silently rewrites the replication, so the bug is
+invisible on the CPU mesh this repo tests on — exactly the class of
+regression a static pass has to catch.
+
+The checker re-traces every registered shard_map program on the local
+device mesh with ``check_rep=False`` — crucially disabling the automatic
+replication rewrite, so an *unmarked* replicated carry stays visible in
+the jaxpr — and walks the shard body enforcing the carry discipline:
+
+    for every scan/while carry inside a shard_map body, if the carry
+    OUTPUT is data-dependent on device-varying inputs (the mapped
+    shard_map operands, or an explicit pvary/pbroadcast mark), the carry
+    INIT must be too.
+
+A replicated init feeding a varying body output is precisely the round-5
+carry mismatch; deriving the init from the mapped operands (or marking
+it with lax.pvary where available — see backend_tpu._varying_inf_tiled)
+satisfies the discipline on every JAX version.
+
+The program is additionally re-traced under the default check_rep so a
+plain carry *type* mismatch (shape/dtype drift between init and body
+output) surfaces as a violation rather than an uncaught exception.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from jax import core as jcore
+
+from .jaxpr_audit import (find_eqns, propagate_taint, outvar_taint,
+                          sub_jaxprs, walk_eqns)
+
+
+@dataclass
+class ShardCaseAudit:
+    name: str
+    t: int
+    nwin: int
+    carries_checked: int = 0
+    violations: list = field(default_factory=list)
+
+
+def _check_loop_carries(jaxpr: jcore.Jaxpr, invar_taint, name: str,
+                        counter=None) -> list[str]:
+    """Walk one jaxpr level, checking every scan/while carry against the
+    taint discipline and descending into loop/call bodies."""
+    if counter is None:
+        counter = [0]
+    violations: list[str] = []
+    taint = propagate_taint(jaxpr, invar_taint)
+
+    def vt(v) -> bool:
+        return (not isinstance(v, jcore.Literal)) and taint.get(v, False)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "scan":
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            body = eqn.params["jaxpr"].jaxpr
+            # scan body invars mirror eqn.invars: consts + carry + xs
+            in_t = [vt(v) for v in eqn.invars]
+            out_t = outvar_taint(body, in_t)
+            for i in range(ncar):
+                counter[0] += 1
+                if out_t[i] and not in_t[nc + i]:
+                    violations.append(
+                        f"{name}: scan carry {i} init is replicated "
+                        f"(device-invariant) but the loop body output is "
+                        f"device-varying — the round-5 shard_map carry "
+                        f"mismatch; derive the init from the mapped "
+                        f"operands or mark it with lax.pvary")
+            violations += _check_loop_carries(body, in_t, name, counter)
+        elif prim == "while":
+            ncc = eqn.params["cond_nconsts"]
+            nbc = eqn.params["body_nconsts"]
+            body = eqn.params["body_jaxpr"].jaxpr
+            in_t = [vt(v) for v in eqn.invars]
+            carry_t = in_t[ncc + nbc:]
+            body_in_t = in_t[ncc:ncc + nbc] + carry_t
+            out_t = outvar_taint(body, body_in_t)
+            for i in range(len(carry_t)):
+                counter[0] += 1
+                if out_t[i] and not carry_t[i]:
+                    violations.append(
+                        f"{name}: while carry {i} init is replicated "
+                        f"(device-invariant) but the loop body output is "
+                        f"device-varying — the round-5 shard_map carry "
+                        f"mismatch; derive the init from the mapped "
+                        f"operands or mark it with lax.pvary")
+            violations += _check_loop_carries(body, body_in_t, name, counter)
+        elif prim == "pjit":
+            body = eqn.params["jaxpr"].jaxpr
+            in_t = [vt(v) for v in eqn.invars]
+            violations += _check_loop_carries(body, in_t, name, counter)
+        elif prim == "cond":
+            # branches share one signature: eqn.invars = [index, *operands]
+            in_t = [vt(v) for v in eqn.invars[1:]]
+            for branch in eqn.params["branches"]:
+                violations += _check_loop_carries(branch.jaxpr, in_t, name,
+                                                  counter)
+        else:
+            # any other higher-order primitive (remat, custom_*, a call
+            # form this checker predates): descend when the nested jaxpr
+            # shares the equation's signature, otherwise REFUSE to pass
+            # a loop we cannot check — silence here is how the round-5
+            # bug class would sneak back in
+            in_t = [vt(v) for v in eqn.invars]
+            for sub in sub_jaxprs(eqn):
+                if len(sub.invars) == len(eqn.invars):
+                    violations += _check_loop_carries(sub, in_t, name,
+                                                      counter)
+                elif any(e.primitive.name in ("scan", "while")
+                         for e in walk_eqns(sub)):
+                    violations.append(
+                        f"{name}: loop inside unhandled higher-order "
+                        f"primitive '{prim}' — carry discipline cannot "
+                        f"be verified; teach analysis/shard_audit about "
+                        f"this primitive or restructure the program")
+    return violations
+
+
+def check_shard_carries(jaxpr: jcore.Jaxpr, name: str) -> tuple[int, list]:
+    """Find every shard_map equation and check its body's loop carries.
+    Returns (carries checked, violations)."""
+    counter = [0]
+    violations: list[str] = []
+    sm_eqns = find_eqns(jaxpr, "shard_map")
+    if not sm_eqns:
+        violations.append(f"{name}: traced program contains no shard_map "
+                          f"equation — registry entry is stale")
+    for eqn in sm_eqns:
+        body = eqn.params["jaxpr"]
+        if isinstance(body, jcore.ClosedJaxpr):
+            body = body.jaxpr
+        in_names = eqn.params["in_names"]
+        # an operand is device-varying iff shard_map maps any mesh axis
+        # over it (non-empty names dict)
+        in_t = [bool(names) for names in in_names]
+        violations += _check_loop_carries(body, in_t, name, counter)
+    return counter[0], violations
+
+
+def audit_shard_case(spec, mesh, t: int, nwin: int,
+                     retrace: bool = True) -> ShardCaseAudit:
+    """Trace one (t, nwin) instantiation of a registered shard program on
+    `mesh` and run the carry discipline + (optional) re-trace checks.
+
+    `retrace=False` skips the check_rep re-trace — tier-1 and the
+    multichip dry run disable it because the replication-checked program
+    is already driven end-to-end there (tests/test_sharding.py, the dry
+    run's own combine) and the rewrite costs ~30-60 s of pure tracing
+    per case on the CPU box; the CLI keeps it on."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    audit = ShardCaseAudit(name=f"{spec.name}[t={t},nwin={nwin}]",
+                           t=t, nwin=nwin)
+    n_dev = int(mesh.devices.size)
+    try:
+        args = spec.make_global_args(n_dev, t, nwin)
+        local = spec.build_local(t, nwin)
+        in_specs = tuple(P("dp") for _ in args)
+        unchecked = shard_map(local, mesh=mesh, in_specs=in_specs,
+                              out_specs=P("dp"), check_rep=False)
+        jaxpr = jax.make_jaxpr(unchecked)(*args).jaxpr
+    except Exception as exc:  # noqa: BLE001 — any trace failure is a finding
+        audit.violations.append(
+            f"{audit.name}: tracing with check_rep=False failed: "
+            f"{type(exc).__name__}: {exc}")
+        return audit
+
+    audit.carries_checked, violations = check_shard_carries(
+        jaxpr, audit.name)
+    audit.violations += violations
+
+    if not retrace:
+        return audit
+    # re-trace under the default replication checking: a carry whose
+    # TYPE (shape/dtype) drifts between init and body output raises here
+    # on every JAX version, and on newer JAX this is also where a pvary
+    # mismatch would surface
+    try:
+        local = spec.build_local(t, nwin)
+        checked = shard_map(local, mesh=mesh, in_specs=in_specs,
+                            out_specs=P("dp"))
+        jax.eval_shape(jax.jit(checked), *args)
+    except Exception as exc:  # noqa: BLE001
+        audit.violations.append(
+            f"{audit.name}: re-trace with replication checking failed: "
+            f"{type(exc).__name__}: {exc}")
+    return audit

@@ -1,0 +1,172 @@
+"""VMEM reconciliation — pass 2 of the kernel contract auditor.
+
+Derives the per-grid-step scoped-VMEM footprint of a traced pallas call
+from its *actual* BlockSpecs — not from comments, not from the model's
+own assumptions about the layout — and cross-checks it against the
+calibrated `ops/vmem_budget` model:
+
+- every block whose index map depends on the grid index is a revolving
+  (double-buffered) buffer in the Mosaic pipeline: 2x its block bytes;
+- every grid-invariant block (the fold-constant table) is held once;
+- the Mosaic value stack is the model's calibrated per-row term (the one
+  component no trace can observe; it was calibrated against the round-5
+  compiler report, see vmem_budget.STACK_BYTES_PER_ROW).
+
+If the BlockSpec-derived footprint drifts from
+`vmem_budget.step_footprint_bytes` beyond a tolerance, the model is no
+longer describing the kernels that actually ship and the audit fails —
+the round-5 failure mode, where the fold-constant operand silently grew
+to a full [36, 32, 8, 128] vreg broadcast (4.5 MiB) while the budget
+reasoning still assumed the small layout, becomes a trace-time error.
+The derived footprint is also checked against the configured budget and
+the 16 MiB hard limit directly, so an over-limit kernel is flagged even
+if model and trace agree with each other.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from jax import core as jcore
+
+from ..ops import vmem_budget as vb
+from .jaxpr_audit import outvar_taint
+
+#: Allowed |BlockSpec-derived − model| drift.  Zero at HEAD; the r05
+#: fold-constant layout drifts by ~3.9 MiB.  Small enough that a padded
+#: or re-tiled operand the model does not know about is flagged, large
+#: enough not to trip on sub-block rounding.
+DEFAULT_TOLERANCE_BYTES = 256 * 1024
+
+
+@dataclass
+class BlockInfo:
+    shape: tuple
+    dtype: str
+    bytes: int
+    grid_dependent: bool
+    is_output: bool
+
+
+@dataclass
+class FootprintAudit:
+    blocks: list
+    tile_rows: int
+    derived_bytes: int          # BlockSpec-derived buffers + stack term
+    model_bytes: int | None     # vmem_budget model (None: no model family)
+    drift_bytes: int | None
+    budget_bytes: int
+    violations: list
+
+
+def block_infos(grid_mapping) -> list[BlockInfo]:
+    """Classify every block of a traced pallas_call's GridMapping."""
+    out = []
+    n_in = grid_mapping.num_inputs
+    for i, bm in enumerate(grid_mapping.block_mappings):
+        imj = bm.index_map_jaxpr.jaxpr
+        # grid-dependent iff any index-map output is data-dependent on
+        # the grid indices (the index map's invars)
+        dep = any(outvar_taint(imj, [True] * len(imj.invars)))
+        sds = bm.array_shape_dtype
+        shape = tuple(int(d) for d in bm.block_shape)
+        nbytes = math.prod(shape) * sds.dtype.itemsize
+        out.append(BlockInfo(shape=shape, dtype=str(sds.dtype),
+                             bytes=int(nbytes), grid_dependent=dep,
+                             is_output=i >= n_in))
+    return out
+
+
+def check_block_divisibility(grid_mapping, kernel_name: str) -> list[str]:
+    """Grid/BlockSpec invariants: every block evenly tiles its operand,
+    rows land on the sublane grid, and the lane axis is exactly LANES."""
+    violations = []
+    for bm in grid_mapping.block_mappings:
+        arr = tuple(int(d) for d in bm.array_shape_dtype.shape)
+        blk = tuple(int(d) for d in bm.block_shape)
+        if len(arr) != len(blk):
+            violations.append(f"{kernel_name}: block rank {blk} does not "
+                              f"match operand rank {arr}")
+            continue
+        for a, b in zip(arr, blk):
+            if b == 0 or a % b:
+                violations.append(
+                    f"{kernel_name}: block {blk} does not evenly tile "
+                    f"operand {arr} (axis {a} % {b} != 0)")
+                break
+        if blk[-1] != vb.LANES:
+            violations.append(
+                f"{kernel_name}: lane axis of block {blk} is {blk[-1]}, "
+                f"kernels must tile full {vb.LANES}-lane vregs")
+        if len(blk) >= 2 and blk[-2] % vb.SUBLANES and blk[-2] != 1:
+            violations.append(
+                f"{kernel_name}: sublane axis of block {blk} is "
+                f"{blk[-2]}, not a multiple of {vb.SUBLANES}")
+    return violations
+
+
+def audit_footprint(grid_mapping, kernel_name: str, *,
+                    n_point_inputs: int | None = None,
+                    with_digits: bool = False,
+                    reconcile: bool = True,
+                    tolerance: int = DEFAULT_TOLERANCE_BYTES,
+                    budget: int | None = None) -> FootprintAudit:
+    """Derive the scoped-VMEM footprint from the BlockSpecs and reconcile
+    it against the vmem_budget model (for families the model covers)."""
+    if budget is None:
+        budget = vb.budget_bytes()
+    blocks = block_infos(grid_mapping)
+    violations: list[str] = []
+
+    revolving = [b for b in blocks if b.grid_dependent]
+    if not revolving:
+        violations.append(f"{kernel_name}: no grid-dependent block at all "
+                          f"(kernel does not tile its operands?)")
+        tile_rows = vb.SUBLANES
+    else:
+        # rows live on the sublane (second-to-last) axis in every layout
+        # of this kernel family; the digit plane agrees by construction
+        tile_rows = max(b.shape[-2] for b in revolving)
+
+    derived = sum((2 if b.grid_dependent else 1) * b.bytes for b in blocks)
+    derived += vb.STACK_BYTES_PER_ROW * tile_rows
+
+    model = drift = None
+    if reconcile and n_point_inputs is not None:
+        model = vb.step_footprint_bytes(n_point_inputs, tile_rows,
+                                        with_digits)
+        drift = abs(derived - model)
+        if drift > tolerance:
+            violations.append(
+                f"{kernel_name}: BlockSpec-derived footprint {derived} B "
+                f"drifts {drift} B from the vmem_budget model ({model} B, "
+                f"tolerance {tolerance} B) — the model no longer describes "
+                f"the shipped kernel layout (round-5 bug class)")
+
+    if derived > vb.HARD_LIMIT_BYTES:
+        violations.append(
+            f"{kernel_name}: BlockSpec-derived footprint {derived} B "
+            f"exceeds the {vb.HARD_LIMIT_BYTES} B scoped-VMEM hard limit "
+            f"— this kernel cannot compile on TPU (round-5 OOM class)")
+    elif derived > budget:
+        violations.append(
+            f"{kernel_name}: BlockSpec-derived footprint {derived} B "
+            f"exceeds the configured {budget} B budget")
+
+    return FootprintAudit(blocks=blocks, tile_rows=tile_rows,
+                          derived_bytes=int(derived), model_bytes=model,
+                          drift_bytes=drift, budget_bytes=budget,
+                          violations=violations)
+
+
+def find_single_pallas_call(jaxpr: jcore.Jaxpr, kernel_name: str):
+    """The audited builders wrap exactly one pallas_call; more or fewer
+    means the registry entry no longer matches the implementation."""
+    from .jaxpr_audit import find_eqns
+
+    eqns = find_eqns(jaxpr, "pallas_call")
+    if len(eqns) != 1:
+        return None, [f"{kernel_name}: expected exactly 1 pallas_call in "
+                      f"the traced builder, found {len(eqns)}"]
+    return eqns[0], []

@@ -12,7 +12,24 @@ import os
 import secrets
 import uuid
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:  # optional dependency — the EIP-2335 AES cipher is the only use;
+    # everything else here is hashlib/stdlib and must import without it.
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+
+    _CRYPTOGRAPHY_ERROR = None
+except ModuleNotFoundError as _exc:  # pragma: no cover - env-dependent
+    Cipher = algorithms = modes = None  # type: ignore[assignment]
+    _CRYPTOGRAPHY_ERROR = _exc
+
+
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise ModuleNotFoundError(
+            "charon_tpu.eth2util.keystore needs the optional "
+            "'cryptography' package for EIP-2335 AES-128-CTR keystores "
+            f"(pip install cryptography): {_CRYPTOGRAPHY_ERROR}"
+        ) from _CRYPTOGRAPHY_ERROR
 
 # Insecure-but-fast scrypt cost for DV key shares, mirroring the
 # reference's choice and rationale (reference: eth2util/keystore/
@@ -32,6 +49,7 @@ def encrypt(secret: bytes, password: str, *,
     Includes the EIP-2335 `path` and `pubkey` fields standard validator
     clients require on import (reference: eth2util/keystore/
     keystore.go:139-172 writes both; round-1 advisor finding)."""
+    _require_cryptography()
     salt = secrets.token_bytes(32)
     iv = secrets.token_bytes(16)
     n = SCRYPT_N_INSECURE if insecure else SCRYPT_N_STANDARD
@@ -61,6 +79,7 @@ def encrypt(secret: bytes, password: str, *,
 
 
 def decrypt(keystore: dict, password: str) -> bytes:
+    _require_cryptography()
     crypto = keystore["crypto"]
     kdf = crypto["kdf"]["params"]
     dk = _scrypt(password.encode(), bytes.fromhex(kdf["salt"]), kdf["n"])

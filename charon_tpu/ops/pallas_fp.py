@@ -127,20 +127,27 @@ def _small_kernel_factory(k: int):
     return _kern
 
 
-def _tiles_call(kernel, n_in: int, a_t, b_t=None):
+def _build_tiles_call(kernel, n_in: int, rows: int, interpret: bool = False):
+    """The pallas_call over `rows` residue rows (rows = SUBLANES·grid).
+    Split from _tiles_call so the kernel-contract auditor
+    (charon_tpu.analysis) can build and trace the identical call."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    nb = a_t.shape[1] // SUBLANES
     spec = pl.BlockSpec((_NL, SUBLANES, LANES), lambda i: (0, i, 0),
                         memory_space=pltpu.VMEM)
-    call = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        grid=(nb,),
+        grid=(rows // SUBLANES,),
         in_specs=[spec] * n_in,
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct(a_t.shape, jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((_NL, rows, LANES), jnp.int32),
+        interpret=interpret,
     )
+
+
+def _tiles_call(kernel, n_in: int, a_t, b_t=None):
+    call = _build_tiles_call(kernel, n_in, a_t.shape[1])
     return call(a_t) if b_t is None else call(a_t, b_t)
 
 
@@ -192,3 +199,45 @@ def _small_kernel(k: int):
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return _binop(_small_kernel(k), a, None)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-contract registration (charon_tpu.analysis): the fp family has no
+# calibrated vmem_budget model (its fixed [NLIMBS, 8, 128] blocks sit far
+# under the budget), so reconcile_budget=False — the auditor still enforces
+# dtype discipline, grid/BlockSpec divisibility, and the budget ceiling on
+# the BlockSpec-derived footprint.  mul_small is registered at k=12 (the
+# largest constant the G2 group law uses, via x3b = x12).
+# ---------------------------------------------------------------------------
+
+_AUDIT_KERNELS = {
+    "mul": (_mul_kernel, 2),
+    "add": (_add_kernel, 2),
+    "sub": (_sub_kernel, 2),
+    "neg": (_neg_kernel, 1),
+    "mul_small[12]": (_small_kernel_factory(12), 1),
+}
+
+
+def _register_kernels():
+    from ..analysis import registry as _reg
+
+    def _make(kernel, n_in):
+        def build(rows: int, interpret: bool = True):
+            return _build_tiles_call(kernel, n_in, rows, interpret)
+
+        def make_args(rows: int) -> tuple:
+            sds = jax.ShapeDtypeStruct((_NL, rows, LANES), np.int32)
+            return (sds,) * n_in
+
+        return build, make_args
+
+    for name, (kernel, n_in) in _AUDIT_KERNELS.items():
+        build, make_args = _make(kernel, n_in)
+        _reg.register_kernel(_reg.KernelSpec(
+            name=f"pallas_fp.{name}", family="fp",
+            n_point_inputs=n_in, with_digits=False,
+            build=build, make_args=make_args, reconcile_budget=False))
+
+
+_register_kernels()

@@ -732,6 +732,52 @@ _DIRECT_FNS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Kernel-contract registration (charon_tpu.analysis): every pallas kernel
+# in this module is registered with a builder the auditor can trace at any
+# budgeted S — the dtype/VMEM contracts are then enforced at trace time
+# with no TPU attached (tests/test_static_analysis.py; `python -m
+# charon_tpu.analysis`).  A kernel added here without a registration line
+# fails the registry-population pin in the tier-1 suite.
+# ---------------------------------------------------------------------------
+
+_KERNEL_TABLE = {
+    "dbl": (_dbl_kernel, 1, False),
+    "add": (_add_kernel, 2, False),
+    "addsel": (_addsel_kernel, 4, True),
+    "dblsel": (_dblsel_kernel, 4, True),
+    "addsel_s": (_addsel_s_kernel, 5, True),
+    "dbl3sel_s": (_dbl3sel_s_kernel, 5, True),
+}
+
+
+def _register_kernels():
+    from ..analysis import registry as _reg
+
+    def _make(kernel, n_pts, with_w):
+        def build(s_rows: int, interpret: bool = True):
+            return _build_call(kernel, n_pts, with_w, s_rows, interpret,
+                               vmem_budget.budget_bytes())
+
+        def make_args(s_rows: int) -> tuple:
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
+            pt = i32(6, NL, s_rows, LANES)
+            args = (i32(_FC_ROWS, NL, LANES),) + (pt,) * n_pts
+            return args + ((i32(s_rows, LANES),) if with_w else ())
+
+        return build, make_args
+
+    for name, (kernel, n_pts, with_w) in _KERNEL_TABLE.items():
+        build, make_args = _make(kernel, n_pts, with_w)
+        _reg.register_kernel(_reg.KernelSpec(
+            name=f"pallas_g2.{name}", family="g2",
+            n_point_inputs=n_pts, with_digits=with_w,
+            build=build, make_args=make_args))
+
+
+_register_kernels()
+
+
 def straus_combine(fc, pts_t, digits, t_count: int, acc0=None):
     """Joint-T Straus MSM over a t-major tiled batch.
 

@@ -16,9 +16,28 @@ from __future__ import annotations
 
 import hashlib
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey, Ed25519PublicKey)
+try:  # `cryptography` is an optional dependency: only the p2p identity/
+    # transport and keystore layers need it, and the TPU math paths must
+    # import (and be testable) without it.
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey, Ed25519PublicKey)
+
+    _CRYPTOGRAPHY_ERROR = None
+except ModuleNotFoundError as _exc:  # pragma: no cover - env-dependent
+    InvalidSignature = None  # type: ignore[assignment,misc]
+    Ed25519PrivateKey = Ed25519PublicKey = None  # type: ignore[assignment]
+    _CRYPTOGRAPHY_ERROR = _exc
+
+
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise ModuleNotFoundError(
+            "charon_tpu.p2p.identity needs the optional 'cryptography' "
+            "package for Ed25519 node identities (pip install "
+            f"cryptography): {_CRYPTOGRAPHY_ERROR}"
+        ) from _CRYPTOGRAPHY_ERROR
+
 
 ENR_PREFIX = "ed25519:"
 
@@ -27,12 +46,14 @@ class NodeIdentity:
     """An Ed25519 identity keypair for one cluster node."""
 
     def __init__(self, priv: Ed25519PrivateKey):
+        _require_cryptography()
         self._priv = priv
         self.pubkey: bytes = priv.public_key().public_bytes_raw()
 
     @classmethod
     def generate(cls, seed: bytes | None = None) -> "NodeIdentity":
         """Fresh identity; with `seed`, deterministic (tests/fixtures only)."""
+        _require_cryptography()
         if seed is None:
             return cls(Ed25519PrivateKey.generate())
         digest = hashlib.sha256(b"charon-tpu-identity" + seed).digest()
@@ -40,6 +61,7 @@ class NodeIdentity:
 
     @classmethod
     def from_bytes(cls, priv32: bytes) -> "NodeIdentity":
+        _require_cryptography()
         return cls(Ed25519PrivateKey.from_private_bytes(priv32))
 
     def to_bytes(self) -> bytes:
@@ -59,6 +81,7 @@ class NodeIdentity:
 
 
 def verify(pubkey32: bytes, sig: bytes, data: bytes) -> bool:
+    _require_cryptography()
     try:
         Ed25519PublicKey.from_public_bytes(pubkey32).verify(sig, data)
         return True

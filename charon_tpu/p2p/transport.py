@@ -42,11 +42,29 @@ import struct
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:  # optional dependency — see p2p/identity.py; the channel-security
+    # layer is unusable without it, but importing this module (for Peer,
+    # framing helpers, type references) must work everywhere.
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    _CRYPTOGRAPHY_ERROR = None
+except ModuleNotFoundError as _exc:  # pragma: no cover - env-dependent
+    X25519PrivateKey = X25519PublicKey = None  # type: ignore[assignment]
+    ChaCha20Poly1305 = None  # type: ignore[assignment,misc]
+    _CRYPTOGRAPHY_ERROR = _exc
 
 from . import identity as ident
+
+
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise ModuleNotFoundError(
+            "charon_tpu.p2p.transport needs the optional 'cryptography' "
+            "package for the X25519/ChaCha20-Poly1305 channel security "
+            f"(pip install cryptography): {_CRYPTOGRAPHY_ERROR}"
+        ) from _CRYPTOGRAPHY_ERROR
 
 MAX_FRAME = 32 * 1024 * 1024
 HS_TIMEOUT = 5.0
@@ -228,6 +246,7 @@ class TCPMesh:
 
     async def _handshake_initiator(self, reader, writer,
                                    peer_index: int) -> _Channel:
+        _require_cryptography()
         eph = X25519PrivateKey.generate()
         eph_i = eph.public_key().public_bytes_raw()
         writer.write(bytes([self.self_index]) + eph_i)
@@ -248,6 +267,7 @@ class TCPMesh:
         return _Channel(reader, writer, peer_index, k_i2r, k_r2i)
 
     async def _handshake_responder(self, reader, writer) -> _Channel:
+        _require_cryptography()
         hello = await asyncio.wait_for(reader.readexactly(1 + 32), HS_TIMEOUT)
         i_index, eph_i = hello[0], hello[1:]
         pub = self.peer_pubkeys.get(i_index)

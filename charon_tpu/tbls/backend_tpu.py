@@ -155,18 +155,45 @@ STRAUS_NWIN = 87
 DBLSEL_NBITS = jcurve.SCALAR_BITS
 
 
-def _varying_inf_tiled(sv: int, axis_names):
+def _varying_inf_tiled(sv: int, axis_names, like=None):
     """∞ accumulator typed device-varying for a shard_map body.
 
     Newer JAX tracks varying manual axes on loop carries: a replicated-
     constant fori_loop init no longer unifies with the dp-varying body
     output (the round-5 carry mismatch that broke straus_combine under
     shard_map).  lax.pvary marks the constant as varying over the mesh
-    axis; older JAX (no lax.pvary) adjusts replication automatically and
-    the plain constant is fine."""
+    axis; on JAX without pvary the varying-ness is derived STRUCTURALLY
+    instead — ``acc0 + 0·like[...]`` is value-identical (int32: 0·x ≡ 0
+    exactly) but data-dependent on the mapped operand `like`, which both
+    satisfies newer JAX's carry unification and makes the carry
+    discipline statically checkable by the analysis shard-carry pass
+    (charon_tpu.analysis.shard_audit) on every JAX version."""
     acc0 = pallas_g2.inf_tiled(sv)
     pvary = getattr(jax.lax, "pvary", None)
-    return pvary(acc0, axis_names) if pvary is not None else acc0
+    if pvary is not None:
+        return pvary(acc0, axis_names)
+    if like is not None:
+        return acc0 + like[:, :, :sv, :] * 0
+    return acc0
+
+
+def _sharded_combine_local(t: int, nwin: int):
+    """The per-device combine body `shard_map` wraps, exposed standalone
+    so the kernel-contract auditor can re-trace it with check_rep=False
+    (see analysis/shard_audit) — the jitted production wrapper below and
+    the auditor must see the SAME body or the audit is theater."""
+
+    def local(p, d):
+        vl = p.shape[0]
+        rows = p.transpose(1, 0, 2, 3, 4).reshape(vl * t, 3, 2, p.shape[-1])
+        digits = d.transpose(2, 1, 0).reshape(nwin, (t * vl) // 128, 128)
+        fc = jnp.asarray(pallas_g2.fold_consts())
+        tiled = pallas_g2.tile_points(rows)
+        acc0 = _varying_inf_tiled(vl // 128, ("dp",), like=tiled)
+        out = pallas_g2.straus_combine(fc, tiled, digits, t, acc0=acc0)
+        return pallas_g2.untile_points(out)
+
+    return local
 
 
 @functools.lru_cache(maxsize=32)
@@ -181,17 +208,7 @@ def _sharded_combine_fn(mesh, t: int, nwin: int, direct: bool):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    def local(p, d):
-        vl = p.shape[0]
-        rows = p.transpose(1, 0, 2, 3, 4).reshape(vl * t, 3, 2, p.shape[-1])
-        digits = d.transpose(2, 1, 0).reshape(nwin, (t * vl) // 128, 128)
-        fc = jnp.asarray(pallas_g2.fold_consts())
-        acc0 = _varying_inf_tiled(vl // 128, ("dp",))
-        out = pallas_g2.straus_combine(fc, pallas_g2.tile_points(rows),
-                                       digits, t, acc0=acc0)
-        return pallas_g2.untile_points(out)
-
-    return jax.jit(shard_map(local, mesh=mesh,
+    return jax.jit(shard_map(_sharded_combine_local(t, nwin), mesh=mesh,
                              in_specs=(P("dp"), P("dp")), out_specs=P("dp")))
 
 
@@ -471,3 +488,58 @@ class TPUBackend:
         ok = (np.asarray(ok) & np.asarray(dec_ok)
               & ~pk_bad & ~sg_bad & length_ok)
         return [bool(b) for b in ok[:n]]
+
+
+# ---------------------------------------------------------------------------
+# Kernel-contract registration (charon_tpu.analysis).  This module owns the
+# V-padding arithmetic, so it registers the workload shapes the combine
+# paths actually emit — including the V=10k/T=7 headline bench shape — and
+# its shard_map program, for the auditor's three passes.
+# ---------------------------------------------------------------------------
+
+#: (V, T) shapes the auditor checks every kernel against: the unit case,
+#: small/medium batches, the headline bench shape, and an over-bench
+#: stress shape.  Every (V, T) yields both the single-chip fused S and the
+#: per-device sharded S (8-device mesh, non-DIRECT tile granularity).
+AUDIT_VT_SHAPES = ((1, 1), (100, 3), (1024, 2), (4096, 4), (10_000, 7),
+                   (50_000, 10))
+
+
+def audit_s_rows(v: int, t: int, n_dev: int = 8) -> dict[str, int]:
+    """Kernel S rows for one (V, T): the fused bytes path pads V to a
+    1024-row multiple (t-major rows), the sharded path pads per-device V
+    to the SUBLANES·LANES pallas tile granularity."""
+    vpad = max(1024, -(-v // 1024) * 1024)
+    gran = pallas_g2.SUBLANES * pallas_g2.LANES
+    v_local = -(-max(1, -(-v // n_dev)) // gran) * gran
+    return {"fused": t * vpad // pallas_g2.LANES,
+            "sharded": t * v_local // pallas_g2.LANES}
+
+
+def shard_audit_args(n_dev: int, t: int, nwin: int) -> tuple:
+    """Global-shape ShapeDtypeStruct args of the sharded combine for the
+    auditor's re-trace: per-device V at the current tile granularity
+    (DIRECT-dependent, like straus_combine_sharded itself)."""
+    v_local = _v_granularity(t)
+    vpad = v_local * n_dev
+    nl = jcurve.fp.NLIMBS
+    return (jax.ShapeDtypeStruct((vpad, t, 3, 2, nl), np.int32),
+            jax.ShapeDtypeStruct((vpad, t, nwin), np.int32))
+
+
+def _register_audit_entries():
+    from ..analysis import registry as _reg
+
+    for v, t in AUDIT_VT_SHAPES:
+        for origin, s_rows in audit_s_rows(v, t).items():
+            _reg.register_workload_shape(_reg.WorkloadShape(
+                family="g2", v=v, t=t, s_rows=s_rows, origin=origin))
+    _reg.register_shard_program(_reg.ShardProgramSpec(
+        name="backend_tpu.straus_combine_sharded",
+        build_local=_sharded_combine_local,
+        make_global_args=shard_audit_args,
+        cases=((2, STRAUS_NWIN), (7, STRAUS_NWIN)),
+    ))
+
+
+_register_audit_entries()

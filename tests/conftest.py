@@ -30,3 +30,28 @@ if os.environ.get("CHARON_TPU_TEST_TPU") != "1":
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import pytest  # noqa: E402
+
+# The ops/tbls device suites run under STRICT dtype promotion: the limb
+# kernels' contract is that everything stays int32, and an implicit
+# promotion (int32 + int64 literal, bool arithmetic, a stray Python
+# float) is exactly the silent-widening bug class the kernel contract
+# auditor polices at trace time — strict mode makes it a test error at
+# the source.  App/core suites keep default promotion (they do no limb
+# math).
+_STRICT_PROMOTION_PREFIXES = (
+    "test_ops", "test_pallas", "test_tbls", "test_sharding",
+    "test_vmem_budget", "test_bench_smoke", "test_static_analysis",
+    "test_batch_verifier",
+)
+
+
+@pytest.fixture(autouse=True)
+def _strict_dtype_promotion(request):
+    name = request.module.__name__.rpartition(".")[2]
+    if name.startswith(_STRICT_PROMOTION_PREFIXES):
+        with jax.numpy_dtype_promotion("strict"):
+            yield
+    else:
+        yield

@@ -139,6 +139,8 @@ def test_monitoring_endpoints():
 
 
 def test_peerinfo_gossip_and_lock_mismatch():
+    pytest.importorskip("cryptography")  # peerinfo rides the TCP mesh
+
     async def main():
         ports = free_ports(2)
         peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(2)]

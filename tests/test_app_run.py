@@ -33,6 +33,7 @@ def insecure_scheme():
 
 
 def test_cli_create_cluster_and_run(tmp_path):
+    pytest.importorskip("cryptography")  # cluster create writes keystores
     cluster_dir = str(tmp_path / "cluster")
     base_port = random.randint(21000, 45000)
     rc = cli_main(["create", "cluster", "--name", "e2e",
@@ -200,6 +201,7 @@ def test_cli_create_dkg_and_sign_flow(tmp_path):
     """Distributed signing flow: `create dkg` emits an unsigned definition,
     each operator signs their entry with `sign`, and the result passes
     default-on verification (dkg refuses unsigned/stripped definitions)."""
+    pytest.importorskip("cryptography")  # operator identities + keystores
     from charon_tpu.cluster.definition import (definition_from_json,
                                                load_json,
                                                verify_definition_signatures)

@@ -128,21 +128,23 @@ def test_fused_combine_bench_path_matches_oracle(kind, monkeypatch,
 
 
 def test_straus_kernels_build_at_bench_shape():
-    """Construct and TRACE every Straus pallas kernel at the headline
-    bench shape (V=10000, T=7 → S=560 rows, budget-tiled grid).  eval_shape
-    runs the full pallas_call build — BlockSpec/grid validation and kernel
-    body tracing — without executing, so this stays fast on CPU."""
-    vpad = -(-10_000 // 1024) * 1024
-    s_rows = 7 * vpad // pallas_g2.LANES
-    tile = vmem_budget.pick_tile_rows(5, s_rows)
-    assert s_rows % tile == 0
-    calls = pallas_g2._straus_calls(s_rows // pallas_g2.SUBLANES,
-                                    True, vmem_budget.budget_bytes())
+    """Trace-audit every Straus pallas kernel at the headline bench shape
+    (V=10000, T=7 → S=560 rows, budget-tiled grid) through the kernel
+    contract auditor: the pallas_call build — BlockSpec/grid validation
+    and kernel body tracing — runs without executing, plus the dtype and
+    VMEM-reconciliation contracts on top of the old shape-only check.
+    The auditor traces each kernel body once per process (shared cache
+    with tests/test_static_analysis.py), so tier-1 pays the ~1 min of
+    group-law body tracing a single time however many suites assert on
+    it."""
+    from charon_tpu.analysis.audit import run_audit
 
-    i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
-    fc = i32(pallas_g2._FC_ROWS, pallas_g2.NL, pallas_g2.LANES)
-    pt = i32(6, pallas_g2.NL, s_rows, pallas_g2.LANES)
-    w = i32(s_rows, pallas_g2.LANES)
-    for name, call in calls.items():
-        out = jax.eval_shape(call, fc, pt, pt, pt, pt, pt, w)
-        assert out.shape == pt.shape, f"{name}: bad out shape {out.shape}"
+    report = run_audit(shapes=[(10_000, 7)], trace="straus", shard=False)
+    assert report.ok, report.summary()
+    by_name = {k.name: k for k in report.kernels}
+    s_rows = 7 * (-(-10_000 // 1024) * 1024) // pallas_g2.LANES
+    for name in ("pallas_g2.addsel_s", "pallas_g2.dbl3sel_s"):
+        k = by_name[name]
+        assert s_rows in k.s_rows_checked
+        assert k.tiles[s_rows] == vmem_budget.pick_tile_rows(5, s_rows)
+        assert k.traced_tile and k.body_eqns > 0

@@ -99,6 +99,7 @@ def _run_ceremony(tmp_path, algorithm: str):
 
 @pytest.mark.parametrize("algorithm", ["pedersen", "keycast"])
 def test_full_ceremony_over_tcp(tmp_path, algorithm):
+    pytest.importorskip("cryptography")  # TCP mesh channel security
     definition, locks = _run_ceremony(tmp_path, algorithm)
     n, t, m = 3, 2, 2
 
@@ -144,6 +145,7 @@ def test_full_ceremony_over_tcp(tmp_path, algorithm):
 def test_equivocating_dealer_detected(tmp_path):
     """A dealer sending different round-1 commitments to different peers is
     named and the ceremony aborts (commitment echo round)."""
+    pytest.importorskip("cryptography")  # TCP mesh channel security
     n, t, m = 3, 2, 1
     ports = free_ports(n)
     ids, _ = new_test_identities(n, seed=b"dkg-equivocate")

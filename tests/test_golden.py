@@ -84,6 +84,8 @@ def test_operator_signatures_sign_and_verify():
     signed definitions verify; any tamper fails."""
     import pytest as _pytest
 
+    _pytest.importorskip("cryptography")  # Ed25519 operator identities
+
     from charon_tpu.cluster.definition import (sign_operator,
                                                verify_definition_signatures)
     from charon_tpu.p2p import identity as ident

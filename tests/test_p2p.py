@@ -5,6 +5,7 @@ core/consensus/component.go:343-353)."""
 
 import asyncio
 import dataclasses
+import importlib.util
 import socket
 
 import pytest
@@ -18,6 +19,14 @@ from charon_tpu.p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
                                       sign_consensus_msg,
                                       verify_consensus_msg)
 from charon_tpu.p2p.transport import Peer, TCPMesh, new_test_identities
+
+# Every test here drives the Ed25519/X25519 channel security, which needs
+# the optional `cryptography` package.  A marker (not importorskip): this
+# module is also imported by tests/test_app_infra.py for `free_ports`,
+# and a collection-time skip would take that whole module down with it.
+pytestmark = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="optional dependency 'cryptography' not installed")
 
 
 def free_ports(n: int) -> list[int]:

@@ -39,6 +39,7 @@ def insecure_scheme():
 
 
 def test_smoke_subprocess_cluster(tmp_path):
+    pytest.importorskip("cryptography")  # cluster create writes keystores
     cluster_dir = str(tmp_path / "cluster")
     base_port = random.randint(23000, 48000)
     assert cli_main(["create", "cluster", "--nodes", str(N),

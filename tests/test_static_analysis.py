@@ -1,0 +1,177 @@
+"""Kernel contract auditor tests (charon_tpu/analysis).
+
+Tier-1 evidence that the auditor (a) passes clean at HEAD for every
+registered workload shape, (b) actually detects both round-5 hardware
+failure classes on the golden-bad fixtures — the over-limit fold-constant
+broadcast layout and the replicated shard_map loop carry — plus a
+float-promotion leak, and (c) is wired into the driver surfaces
+(`python -m charon_tpu.analysis`, the bench preflight).
+
+Cost notes: tracing a fused group-law kernel body is tens of seconds, so
+the fast lane traces only the default-path (Straus) kernels — sharing the
+process-wide trace cache with tests/test_bench_smoke.py — and the full
+all-kernel trace audit runs in the slow lane and in the CLI.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from charon_tpu.analysis import registry
+from charon_tpu.analysis.audit import TRACE_SETS, run_audit
+from charon_tpu.analysis.fixtures import audit_golden_bad
+
+EXPECTED_G2 = {f"pallas_g2.{n}" for n in
+               ("dbl", "add", "addsel", "dblsel", "addsel_s", "dbl3sel_s")}
+EXPECTED_FP = {f"pallas_fp.{n}" for n in
+               ("mul", "add", "sub", "neg", "mul_small[12]")}
+
+
+def test_registry_population():
+    """Every pallas kernel, the backend workload shapes (including the
+    V=10k/T=7 bench shape), and the shard program are registered — a new
+    kernel without a registration line fails here."""
+    registry.ensure_populated()
+    names = {k.name for k in registry.kernels()}
+    assert EXPECTED_G2 <= names and EXPECTED_FP <= names
+    vt = {(s.v, s.t) for s in registry.workload_shapes("g2")}
+    assert (10_000, 7) in vt and (1, 1) in vt
+    origins = {s.origin for s in registry.workload_shapes("g2")}
+    assert origins == {"fused", "sharded"}
+    progs = {p.name for p in registry.shard_programs()}
+    assert "backend_tpu.straus_combine_sharded" in progs
+
+
+def test_arithmetic_audit_clean_for_every_registered_shape():
+    """Grid/divisibility + budget-model arithmetic for EVERY kernel at
+    EVERY registered (V, T) shape — no tracing, sub-second."""
+    report = run_audit(trace="none", shard=False)
+    assert report.ok, report.summary()
+    assert (10_000, 7) in report.shapes_checked
+    for k in report.kernels:
+        assert k.s_rows_checked, f"{k.name}: no shapes checked"
+
+
+def test_shard_carry_discipline_clean_at_head():
+    """Pass 3 on the real sharded combine (t=2 and t=7 on the 8-virtual-
+    device CPU mesh): every fori_loop carry is device-varying-by-
+    construction.  retrace=False — the replication-checked program is
+    executed end-to-end by tests/test_sharding.py."""
+    cases = run_audit(trace="none", shard=True,
+                      shard_retrace=False).shard_cases
+    assert len(cases) >= 2
+    for case in cases:
+        assert case.carries_checked >= 2, case.name
+        assert not case.violations, case.violations
+
+
+def test_straus_kernels_trace_audit_clean():
+    """The full traced passes (dtype discipline, BlockSpec divisibility,
+    VMEM reconciliation) over the default-path kernels.  Reconciliation
+    must be EXACT at HEAD: the budget model and the real BlockSpecs
+    describe the same layout, so drift is zero bytes."""
+    report = run_audit(trace="straus", shard=False)
+    assert report.ok, report.summary()
+    traced = {k.name: k for k in report.kernels if k.traced_tile}
+    assert set(TRACE_SETS["straus"]) <= set(traced)
+    for name in TRACE_SETS["straus"]:
+        k = traced[name]
+        assert k.body_eqns and k.derived_bytes
+        assert k.drift_bytes == 0, (name, k.drift_bytes)
+        assert k.derived_bytes == k.model_bytes
+    # fp kernels ride along whenever tracing is on (cheap bodies)
+    assert "pallas_fp.mul" in traced
+
+
+@pytest.mark.slow
+def test_all_kernels_trace_audit_clean():
+    report = run_audit(trace="all", shard=False)
+    assert report.ok, report.summary()
+    assert all(k.traced_tile for k in report.kernels)
+
+
+def test_golden_bad_r05_vmem_layout_flagged():
+    """The round-5 fold-constant vreg broadcast ([36, 32, 8, 128]): the
+    BlockSpec-derived footprint must exceed the 16 MiB hard limit AND
+    drift >4 MiB from the model — both flagged."""
+    report = audit_golden_bad("r05_vmem")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "hard limit" in text and "drifts" in text
+    # the derived footprint reproduces the r05 compiler report (~17.5 MiB)
+    k = report.kernels[0]
+    assert 17 * 2**20 < k.derived_bytes < 18.5 * 2**20
+
+
+def test_golden_bad_replicated_carry_flagged():
+    """The round-5 shard_map carry: a fori_loop accumulator initialised
+    from the replicated ∞ constant while the body output is device-
+    varying must be flagged by the static taint pass (this JAX's
+    check_rep rewrite silently repairs it, so only a static check can
+    catch it before newer-JAX hardware runs)."""
+    report = audit_golden_bad("replicated_carry")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "carry" in text and "replicated" in text
+
+
+def test_golden_bad_float_leak_flagged():
+    report = audit_golden_bad("float_leak")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "float32" in text and "sqrt" in text
+
+
+def test_cli_golden_bad_exits_nonzero():
+    """`python -m charon_tpu.analysis --golden-bad r05_vmem` is the
+    driver-level contract: non-zero exit on a known-bad kernel set."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "charon_tpu.analysis",
+         "--golden-bad", "r05_vmem"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL" in proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_full_audit_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "charon_tpu.analysis", "--trace", "all"],
+        capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+def test_bench_preflight_gate_wired():
+    """bench.py must refuse to start when the audit fails; the gate is
+    exercised in-process by pointing the preflight at a poisoned budget
+    environment is overkill — instead pin that the gate exists and runs
+    the audit function (the CLI/golden tests above prove detection)."""
+    import bench
+
+    assert hasattr(bench, "_preflight_audit")
+    # and the happy path is callable at a tiny shape without device work
+    bench._preflight_audit(1, 1)  # must not raise / exit
+
+
+def test_strict_dtype_promotion_active_in_ops_suites():
+    """The conftest fixture puts this module (and the ops/tbls suites)
+    under strict promotion: mixing int16/int32 must raise instead of
+    silently widening."""
+    with pytest.raises(Exception, match="[Pp]romot"):
+        _ = (jnp.zeros((4,), jnp.int16) + jnp.zeros((4,), jnp.int32))
+
+
+def test_float_dtype_screen_matches_jax():
+    """The auditor's allowed-dtype set must cover everything the real
+    kernels produce (int32 + bool) and nothing floating."""
+    from charon_tpu.analysis.jaxpr_audit import ALLOWED_KERNEL_DTYPES
+
+    assert "int32" in ALLOWED_KERNEL_DTYPES
+    assert not any(d.startswith("float") or d.startswith("complex")
+                   for d in ALLOWED_KERNEL_DTYPES)
+    assert str(jnp.zeros((1,), jnp.int32).dtype) in ALLOWED_KERNEL_DTYPES
