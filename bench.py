@@ -45,28 +45,45 @@ def _enable_compile_cache():
 
 def _preflight_audit(v: int, t: int) -> None:
     """Kernel contract preflight (charon_tpu.analysis): trace-audit the
-    kernels of the active MSM path at THIS bench's (V, T) shape and
-    refuse to start against an unauditable kernel set.  The round-5 bench
-    burned a full TPU session discovering at AOT-compile time that its
-    kernel needed 17.48 MiB of scoped VMEM; the same violation is now a
-    preflight error before any device work.  CHARON_TPU_PREFLIGHT=0
+    kernels of the active MSM path at THIS bench's (V, T) shape — plus
+    the pairing kernel family at every registered verify batch shape —
+    and refuse to start against an unauditable kernel set.  The round-5
+    bench burned a full TPU session discovering at AOT-compile time that
+    its kernel needed 17.48 MiB of scoped VMEM; the same violation is now
+    a preflight error before any device work.  CHARON_TPU_PREFLIGHT=0
     skips (e.g. when iterating on a knowingly-dirty kernel)."""
     if os.environ.get("CHARON_TPU_PREFLIGHT", "1") == "0":
         return
     from charon_tpu.analysis.audit import run_audit
 
+    from charon_tpu.tbls import backend_tpu
+
     kind = os.environ.get("CHARON_TPU_MSM", "straus")
     trace = kind if kind in ("straus", "dblsel") else "all"
     report = run_audit(shapes=[(v, t)], trace=trace, shard=False)
-    if not report.ok:
-        print(report.summary(), file=sys.stderr)
+    violations = list(report.violations)
+    summaries = [report.summary()]
+    pairing_note = "pairing path inactive (arith-only)"
+    # trace the pairing family only when the fused verify path would
+    # actually serve this bench (TPU backend / forced on) — its grid
+    # arithmetic is always covered by the run above, and tier-1's
+    # in-process call to this gate stays within the fast-lane budget
+    if backend_tpu._use_pairing_fused(2048):
+        pairing_report = run_audit(trace="pairing", shard=False)
+        violations += pairing_report.violations
+        summaries.append(pairing_report.summary())
+        pairing_note = "pairing family traced at registered verify batches"
+    if violations:
+        for s in summaries:
+            print(s, file=sys.stderr)
         print(json.dumps({
             "error": "kernel contract audit failed — refusing to bench",
-            "violations": report.violations,
+            "violations": violations,
         }))
         sys.exit(2)
     print(f"preflight: kernel contract audit PASS "
-          f"({len(report.kernels)} kernels at V={v} T={t})",
+          f"({len(report.kernels)} kernels at V={v} T={t}; "
+          f"{pairing_note})",
           file=sys.stderr)
 
 
@@ -181,6 +198,8 @@ def main() -> None:
     assert implied < 1e14, f"implied {implied:.2e} Fp-mul/s is not credible"
 
     # ---- batched pairing verification (the other half of the north star) --
+    from charon_tpu.tbls import backend_tpu
+
     VV = min(V, 2048)   # verification entries per launch
     NKEYS, NMSGS = 8, 4
     vmsgs = [b"bench-verify-%d" % k for k in range(NMSGS)]
@@ -188,12 +207,25 @@ def main() -> None:
     pks = {sk: refcurve.g1_to_bytes(bls.sk_to_pk(sk)) for sk in vsks}
     sigs = {(sk, m): refcurve.g2_to_bytes(bls.sign(sk, m))
             for sk in vsks for m in vmsgs}
-    entries = []
-    for k in range(VV):
-        sk = vsks[k % NKEYS]
-        m = vmsgs[(k // NKEYS) % NMSGS]
-        entries.append((pks[sk], m, sigs[(sk, m)]))
+
+    def verify_entries_for(count):
+        out = []
+        for k in range(count):
+            sk = vsks[k % NKEYS]
+            m = vmsgs[(k // NKEYS) % NMSGS]
+            out.append((pks[sk], m, sigs[(sk, m)]))
+        return out
+
+    entries = verify_entries_for(VV)
     assert all(api.batch_verify(entries))           # compile + warmup + check
+    # honesty: a corrupted signature inside an otherwise-valid batch must
+    # still be rejected through the RLC batch check + per-row recheck
+    bad = list(entries)
+    bad[VV // 2] = (bad[VV // 2][0], b"bench-corrupted-msg",
+                    bad[VV // 2][2])
+    bad_ok = api.batch_verify(bad)
+    assert not bad_ok[VV // 2] and sum(bad_ok) == VV - 1, \
+        "batch verify failed to isolate the corrupted row"
     vtimes = []
     for _ in range(max(3, REPS // 2)):
         t0 = time.perf_counter()
@@ -202,6 +234,14 @@ def main() -> None:
         assert all(ok)
     vtimes.sort()
     vp99 = vtimes[min(len(vtimes) - 1, int(len(vtimes) * 0.99))]
+    verify_sigs_per_s = round(VV / vtimes[len(vtimes) // 2], 1)
+
+    # ---- the 5 BASELINE.json configs, one JSON entry per config ----------
+    configs = []
+    if os.environ.get("CHARON_TPU_BENCH_CONFIGS", "1") != "0":
+        configs = _run_baseline_configs(
+            api, rng, pool_bytes, oracle_combine_row,
+            verify_entries_for, REPS)
 
     result = {
         "metric": "sigagg_latency_p99_ms",
@@ -209,17 +249,176 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(0.100 / p99, 4),
         "V": V, "T": T, "reps": REPS,
+        "rep_times_ms": [round(t * 1e3, 3) for t in times],
         "p50_ms": round(p50 * 1e3, 3),
         "best_ms": round(best * 1e3, 3),
         "throughput_agg_s": round(V / p50, 1),
         "implied_fp_mul_s": round(implied, 1),
         "verify_entries": VV,
+        "verify_rep_times_ms": [round(t * 1e3, 3) for t in vtimes],
         "verify_p99_ms": round(vp99 * 1e3, 3),
-        "verify_throughput_sig_s": round(VV / vtimes[len(vtimes) // 2], 1),
+        "verify_throughput_sig_s": verify_sigs_per_s,
+        "verify_target_sigs_per_s": 10_000,
+        "verify_baseline_r04_sigs_per_s": 1976,
+        "verify_vs_r04": round(verify_sigs_per_s / 1976, 2),
+        "verify_path": backend_tpu.pairing_path(VV),
+        "configs": configs,
         "oracle_checked": True,
         "platform": jax.devices()[0].platform,
     }
-    print(json.dumps(result))
+    out = json.dumps(result)
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r06.json")
+        with open(path, "w") as fh:
+            fh.write(out + "\n")
+    except OSError:
+        pass
+    print(out)
+
+
+def _run_baseline_configs(api, rng, pool_bytes,
+                          oracle_combine_row, verify_entries_for,
+                          reps: int) -> list:
+    """Measure the 5 BASELINE.json configs: per config, `reps` timed
+    end-to-end repetitions of its duty workload (threshold combine of its
+    row batch + batched verify of its entry batch), every rep's wall time
+    recorded in rep_times_ms.  Combine rows draw fresh arrangements from
+    the device-generated distinct-point pool (bench.main's honesty
+    scheme) and one row per rep is oracle-checked."""
+    import time
+
+    import numpy as np
+
+    POOL = pool_bytes.shape[0]
+
+    def combine_batch(rows, t_count):
+        idxs = tuple(range(1, t_count + 1))
+        pick = rng.integers(0, POOL, (rows, t_count))
+        raw = pool_bytes[pick]
+        return [{i: raw[v, k].tobytes() for k, i in enumerate(idxs)}
+                for v in range(rows)]
+
+    def run_config(name, rows, t_count, verify_count, verify_fn=None):
+        ctimes, vtimes, rep_times = [], [], []
+        ventries = (verify_entries_for(verify_count)
+                    if verify_fn is None else None)
+        if rows:
+            api.threshold_combine(combine_batch(rows, t_count))  # warmup
+        if verify_fn is None:
+            assert all(api.batch_verify(ventries))               # warmup
+        else:
+            assert all(verify_fn())
+        for _ in range(reps):
+            batch = combine_batch(rows, t_count) if rows else None
+            t0 = time.perf_counter()
+            if batch is not None:
+                out = api.threshold_combine(batch)
+                ctimes.append(time.perf_counter() - t0)
+            tv = time.perf_counter()
+            ok = api.batch_verify(ventries) if verify_fn is None \
+                else verify_fn()
+            vtimes.append(time.perf_counter() - tv)
+            rep_times.append(time.perf_counter() - t0)
+            assert all(ok)
+            if batch is not None:
+                v = int(rng.integers(0, rows))
+                assert out[v] == oracle_combine_row(batch[v]), \
+                    f"{name}: device combine != oracle at row {v}"
+        entry = {
+            "config": name, "V": rows, "T": t_count, "reps": reps,
+            "rep_times_ms": [round(t * 1e3, 3) for t in rep_times],
+            "verify_entries": verify_count,
+            "verify_ms": [round(t * 1e3, 3) for t in vtimes],
+            "verify_sigs_per_s": round(
+                verify_count / sorted(vtimes)[len(vtimes) // 2], 1),
+        }
+        if ctimes:
+            entry["combine_ms"] = [round(t * 1e3, 3) for t in ctimes]
+            entry["combine_agg_per_s"] = round(
+                rows / sorted(ctimes)[len(ctimes) // 2], 1)
+        return entry
+
+    configs = [
+        # 1. Attestation duty, 1 validator, 4-of-4 (simnet baseline shape)
+        run_config("attestation-1v-4of4", 1, 4, 1),
+        # 2. Attestation + SyncCommitteeMessage, 500 validators, 3-of-4:
+        #    2 duty rows per validator
+        run_config("att+sync-500v-3of4", 1000, 3, 1000),
+        # 3. BeaconBlock + BlindedBlock RANDAO/sig, 5-of-7: 4 duty rows
+        run_config("block+blinded-5of7", 4, 5, 4),
+        # 4. AggregateAndProof + SyncContribution selection-proof batch,
+        #    2k validators — the headline ≥10k sigs/s verify shape
+        run_config("selection-proofs-2k", 2000, 7, 2048),
+        # 5. FROST DKG keygen batched share-verify, 1k validators, 7-of-10
+        run_config("dkg-share-verify-1000v-7of10", 0, 7, 1000,
+                   verify_fn=_dkg_share_verify_workload(rng)),
+    ]
+    return configs
+
+
+def _dkg_share_verify_workload(rng):
+    """BASELINE config 5: 1,000 validators' 7-of-10 DKG share-possession
+    proofs verified in ONE batched pairing launch (dkg/keygen.py
+    verify_share_proofs).  Setup builds real Shamir shares host-side and
+    the pubshares (share·G1) and proofs (share·H(transcript)) in two
+    batched device scalar-mul launches; the timed region is the batched
+    verify itself — the DKG's round-2 hot call."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from charon_tpu.dkg import keygen
+    from charon_tpu.ops import codec
+    from charon_tpu.ops import curve as jcurve
+    from charon_tpu.ops.curve import FP_OPS
+    from charon_tpu.tbls import shamir
+    from charon_tpu.tbls.ref.hash_to_curve import hash_to_g2
+
+    NV, T_DKG, N_DKG = 1000, 7, 10
+    transcript = b"bench-dkg-ceremony-transcript-hash"
+    share_ints = []
+    for v in range(NV):
+        sk = int(rng.integers(1, 1 << 62))
+        shares, _ = shamir.split_secret(sk, T_DKG, N_DKG)
+        share_ints.append(shares[(v % N_DKG) + 1])
+    bits = jnp.asarray(jcurve.scalars_to_bits(share_ints))
+    # pubshares: share·G1, batched on device
+    g1 = jcurve.scalar_mul(
+        FP_OPS, jnp.broadcast_to(jnp.asarray(jcurve.G1_GEN),
+                                 (NV,) + jcurve.G1_GEN.shape), bits)
+    pub_bytes = codec.g1_compress_np(*map(np.asarray, codec.g1_normalize(g1)))
+    # proofs: share·H(transcript msg), batched on device
+    hm = jcurve.g2_pack([hash_to_g2(keygen.share_proof_msg(transcript))])[0]
+    proofs = gen_points_for_base(hm, bits)
+    items = [(pub_bytes[v].tobytes(), proofs[v].tobytes())
+             for v in range(NV)]
+
+    def run():
+        return keygen.verify_share_proofs(items, transcript)
+
+    return run
+
+
+def gen_points_for_base(base_packed, bits):
+    """share·base as compressed bytes — bench.main's `_gen_points` is
+    closed over H(bench msg), so rebuild the same two-launch pipeline for
+    an arbitrary base point."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import codec
+    from charon_tpu.ops import curve as jcurve
+    from charon_tpu.ops.curve import F2_OPS
+
+    @jax.jit
+    def _gen(b):
+        pts = jcurve.scalar_mul(
+            F2_OPS, jnp.broadcast_to(jnp.asarray(base_packed),
+                                     (b.shape[0],) + base_packed.shape), b)
+        return codec.g2_normalize(pts)
+
+    return codec.g2_compress_np(*map(np.asarray, _gen(bits)))
 
 
 if __name__ == "__main__":

@@ -29,7 +29,7 @@ def main(argv=None) -> int:
                     help="audit a known-broken fixture instead of HEAD "
                          "(expected exit status: non-zero)")
     ap.add_argument("--trace", default="all",
-                    choices=["all", "straus", "dblsel", "none"],
+                    choices=["all", "straus", "dblsel", "pairing", "none"],
                     help="which kernels get the expensive traced passes "
                          "(grid arithmetic always covers all)")
     ap.add_argument("--no-shard", action="store_true",

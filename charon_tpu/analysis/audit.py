@@ -20,6 +20,7 @@ once.
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from dataclasses import asdict, dataclass, field
 
@@ -29,12 +30,16 @@ from .vmem_audit import (audit_footprint, check_block_divisibility,
                          find_single_pallas_call)
 
 #: Kernel-name subsets for the `trace` knob: the bench preflight traces
-#: only the kernels of the active MSM path, the full audit traces all.
+#: only the kernels of the active MSM / pairing path, the full audit
+#: traces all.
 TRACE_SETS = {
     "straus": ("pallas_g2.dbl", "pallas_g2.add", "pallas_g2.addsel_s",
                "pallas_g2.dbl3sel_s"),
     "dblsel": ("pallas_g2.dbl", "pallas_g2.add", "pallas_g2.addsel",
                "pallas_g2.dblsel"),
+    "pairing": ("pallas_pairing.pp_dbl", "pallas_pairing.pp_add",
+                "pallas_pairing.pp_sqr", "pallas_pairing.pp_mul014",
+                "pallas_pairing.pp_f12mul", "pallas_pairing.pp_g1_dblsel"),
 }
 
 # process-lifetime cache: (kernel name, tile rows) -> closed jaxpr
@@ -150,6 +155,15 @@ def audit_kernel(spec: registry.KernelSpec, s_rows_list, *,
             except ValueError as exc:
                 audit.violations.append(f"{spec.name} at S={s_rows}: {exc}")
                 continue
+        elif spec.family == "pairing":
+            try:
+                tile = vb.pick_tile_rows_planes(spec.n_in_planes,
+                                                spec.n_out_planes, s_rows,
+                                                with_digits=spec.with_digits,
+                                                budget=budget)
+            except ValueError as exc:
+                audit.violations.append(f"{spec.name} at S={s_rows}: {exc}")
+                continue
         else:
             tile = vb.SUBLANES
         audit.tiles[s_rows] = tile
@@ -183,10 +197,15 @@ def audit_kernel(spec: registry.KernelSpec, s_rows_list, *,
 
     audit.violations += audit_kernel_body(body, spec.name)
     audit.violations += check_block_divisibility(gm, spec.name)
+    model_fn = None
+    if spec.family == "pairing":
+        model_fn = functools.partial(vb.pairing_step_footprint_bytes,
+                                     spec.n_in_planes, spec.n_out_planes,
+                                     with_digits=spec.with_digits)
     foot = audit_footprint(
         gm, spec.name, n_point_inputs=spec.n_point_inputs,
         with_digits=spec.with_digits, reconcile=spec.reconcile_budget,
-        tolerance=tolerance, budget=budget)
+        tolerance=tolerance, budget=budget, model_fn=model_fn)
     audit.derived_bytes = foot.derived_bytes
     audit.model_bytes = foot.model_bytes
     audit.drift_bytes = foot.drift_bytes
@@ -201,7 +220,9 @@ def audit_kernel(spec: registry.KernelSpec, s_rows_list, *,
 
 def _shape_s_rows(family: str, shapes=None):
     """s_rows per (V, T): from explicit shapes via the backend's padding
-    arithmetic, else from the registered workload shapes."""
+    arithmetic, else from the registered workload shapes.  For the
+    pairing family V is the verify batch size (T is pairs-per-signature,
+    fixed at 2 by the verification equation)."""
     out: dict[int, list] = {}
     if shapes is None:
         for ws in registry.workload_shapes(family):
@@ -210,8 +231,12 @@ def _shape_s_rows(family: str, shapes=None):
         from ..tbls import backend_tpu
 
         for v, t in shapes:
-            for origin, s_rows in backend_tpu.audit_s_rows(v, t).items():
-                out.setdefault(s_rows, []).append((v, t, origin))
+            if family == "pairing":
+                s_rows = backend_tpu.verify_audit_s_rows(v)
+                out.setdefault(s_rows, []).append((v, 2, "fused"))
+            else:
+                for origin, s_rows in backend_tpu.audit_s_rows(v, t).items():
+                    out.setdefault(s_rows, []).append((v, t, origin))
     return out
 
 
@@ -222,8 +247,9 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
 
     shapes : optional [(V, T), ...] overriding the registered workload
              shapes (the bench preflight audits its own shape).
-    trace  : "all" | "straus" | "dblsel" | "none" — which kernels get the
-             expensive traced passes; grid arithmetic always covers all.
+    trace  : "all" | "straus" | "dblsel" | "pairing" | "none" — which
+             kernels get the expensive traced passes; grid arithmetic
+             always covers all.
     shard  : run the shard-carry pass over the registered shard_map
              programs on the local device mesh.
     shard_retrace : also re-trace each shard program with replication
@@ -233,6 +259,7 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
     report = AuditReport()
 
     s_rows_map = _shape_s_rows("g2", shapes)
+    pairing_map = _shape_s_rows("pairing", shapes)
     report.shapes_checked = sorted(
         {(v, t) for rows in s_rows_map.values() for (v, t, _) in rows})
     trace_names = (set() if trace == "none" else
@@ -242,6 +269,11 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
     for spec in registry.kernels():
         if spec.family == "g2":
             s_rows_list = list(s_rows_map)
+        elif spec.family == "pairing":
+            # verify-batch shapes (registered by tbls/backend_tpu); the
+            # 8-row fallback keeps the kernel audited even with an
+            # explicit g2-only shape override
+            s_rows_list = list(pairing_map) or [8]
         else:
             # fp kernels tile a fixed [NLIMBS, 8, 128] block; audit the
             # 1-tile and many-tile grids

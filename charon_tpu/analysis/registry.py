@@ -29,15 +29,19 @@ class KernelSpec:
     ``with_digits`` mirror the `ops/vmem_budget` model parameters for the
     VMEM reconciliation pass; ``reconcile_budget`` is False for families
     the calibrated model does not cover (they still get the dtype, grid,
-    and budget-ceiling checks)."""
+    and budget-ceiling checks).  The "pairing" family sizes its operands
+    in Fp limb PLANES instead of whole G2 points: ``n_in_planes`` /
+    ``n_out_planes`` mirror `vmem_budget.pairing_step_footprint_bytes`."""
 
     name: str                           # e.g. "pallas_g2.dbl3sel_s"
-    family: str                         # "g2" | "fp"
+    family: str                         # "g2" | "fp" | "pairing"
     n_point_inputs: int
     with_digits: bool
     build: Callable[[int], Callable[..., Any]]
     make_args: Callable[[int], tuple]
     reconcile_budget: bool = True
+    n_in_planes: int = 0                # pairing family only
+    n_out_planes: int = 0               # pairing family only
 
 
 @dataclass(frozen=True)
@@ -104,4 +108,5 @@ def ensure_populated() -> None:
     the registry; the imports are no-ops when already loaded."""
     from ..ops import pallas_fp  # noqa: F401
     from ..ops import pallas_g2  # noqa: F401
+    from ..ops import pallas_pairing  # noqa: F401
     from ..tbls import backend_tpu  # noqa: F401

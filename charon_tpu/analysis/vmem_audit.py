@@ -111,9 +111,14 @@ def audit_footprint(grid_mapping, kernel_name: str, *,
                     with_digits: bool = False,
                     reconcile: bool = True,
                     tolerance: int = DEFAULT_TOLERANCE_BYTES,
-                    budget: int | None = None) -> FootprintAudit:
+                    budget: int | None = None,
+                    model_fn=None) -> FootprintAudit:
     """Derive the scoped-VMEM footprint from the BlockSpecs and reconcile
-    it against the vmem_budget model (for families the model covers)."""
+    it against the vmem_budget model (for families the model covers).
+
+    ``model_fn(tile_rows) -> bytes`` overrides the default G2 point-block
+    model — the pairing family passes
+    ``vmem_budget.pairing_step_footprint_bytes`` through it."""
     if budget is None:
         budget = vb.budget_bytes()
     blocks = block_infos(grid_mapping)
@@ -133,9 +138,12 @@ def audit_footprint(grid_mapping, kernel_name: str, *,
     derived += vb.STACK_BYTES_PER_ROW * tile_rows
 
     model = drift = None
-    if reconcile and n_point_inputs is not None:
+    if reconcile and model_fn is not None:
+        model = model_fn(tile_rows)
+    elif reconcile and n_point_inputs is not None:
         model = vb.step_footprint_bytes(n_point_inputs, tile_rows,
                                         with_digits)
+    if model is not None:
         drift = abs(derived - model)
         if drift > tolerance:
             violations.append(

@@ -291,6 +291,11 @@ class App:
         self.registry.set_gauge("core_verify_launches_total", v.launches)
         self.registry.set_gauge("core_verify_entries_total", v.entries_total)
         self.registry.set_gauge("core_verify_max_batch", v.max_batch)
+        for path, count in v.paths.items():
+            # which pairing implementation served the launches: a silent
+            # fused→jnp fallback (tbls/backend_tpu) shows up here
+            self.registry.set_gauge("core_verify_launches_by_path", count,
+                                    labels={"path": path})
 
     async def _pubkey_by_index(self, index: int) -> PubKey:
         if not self._index_to_pubkey:

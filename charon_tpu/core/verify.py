@@ -10,8 +10,17 @@ one event-loop tick is coalesced into ONE `tbls.batch_verify` launch
 (2 pairings per entry, batched across all validators and peers).
 
 A `flush_interval` of 0 keeps worst-case added latency at one loop tick.
-Counters (`launches`, `entries_total`, `max_batch`) surface batching
-efficacy at /metrics and in tests.
+Counters (`launches`, `entries_total`, `max_batch`, per-path `paths`)
+surface batching efficacy at /metrics and in tests.
+
+Coalescing matters twice over on the TPU backend: beyond amortising the
+launch, the batched `tbls.batch_verify` it lands in runs the fused pallas
+random-linear-combination check (tbls/backend_tpu) — 2 Miller-loop rows
+per signature and ONE final exponentiation for the whole coalesced batch
+— so a bigger tick batch is strictly cheaper per signature, not merely
+launch-amortised.  `paths` counts launches per pairing implementation
+(`pallas-rlc` / `jnp` / `cpu` / `insecure-test`) so a silent fallback is
+visible at /metrics.
 """
 
 from __future__ import annotations
@@ -38,6 +47,7 @@ class BatchVerifier:
         self.launches = 0
         self.entries_total = 0
         self.max_batch = 0
+        self.paths: dict = {}  # pairing path -> launch count
 
     async def verify(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         """Queue one (pubkey, msg, sig); resolves when the batched launch
@@ -80,6 +90,8 @@ class BatchVerifier:
         self.launches += 1
         self.entries_total += len(flat)
         self.max_batch = max(self.max_batch, len(flat))
+        path = tbls.verify_path(len(flat))
+        self.paths[path] = self.paths.get(path, 0) + 1
         pos = 0
         for item in batch:
             n = len(item.entries)

@@ -105,6 +105,65 @@ def step_footprint_bytes(n_point_inputs: int, tile_rows: int,
     return pts + fc_block_bytes() + digits + stack
 
 
+# ---------------------------------------------------------------------------
+# Pairing-kernel footprint model (ops/pallas_pairing).
+#
+# The pairing kernels do not move whole G2 points; their operands are
+# stacks of Fp limb PLANES — an Fp12 element is 12 planes, a line triple 6,
+# a projective G1 point 3 — each plane a [NLIMBS, tile_rows, LANES] int32
+# block.  The footprint shape is otherwise identical to the G2 family:
+# grid-dependent operands (inputs and outputs) are double-buffered by the
+# Mosaic pipeline, the fold-constant table is held once, and the value
+# stack uses the same calibrated per-row term (the pairing bodies are the
+# same _f2mul/_reduce material as the group-law kernels, split so no
+# single body is deeper than the calibrated dbl³+add kernel).
+# ---------------------------------------------------------------------------
+
+def plane_block_bytes(n_planes: int, tile_rows: int) -> int:
+    """One [n_planes, NLIMBS, tile_rows, LANES] int32 plane-stack block."""
+    return n_planes * NLIMBS * tile_rows * LANES * INT32
+
+
+def pairing_step_footprint_bytes(n_in_planes: int, n_out_planes: int,
+                                 tile_rows: int,
+                                 with_digits: bool = False) -> int:
+    """Scoped-VMEM bytes one grid step of a pallas_pairing kernel holds
+    live: revolving input + output plane stacks (2× each), the single-
+    buffered fold-constant block, the window plane (the G1 RLC-scaling
+    kernel only), and the value stack."""
+    planes = 2 * plane_block_bytes(n_in_planes + n_out_planes, tile_rows)
+    digits = 2 * digit_block_bytes(tile_rows) if with_digits else 0
+    return (planes + digits + fc_block_bytes()
+            + STACK_BYTES_PER_ROW * tile_rows)
+
+
+def pick_tile_rows_planes(n_in_planes: int, n_out_planes: int, s_rows: int,
+                          with_digits: bool = False,
+                          budget: int | None = None) -> int:
+    """pick_tile_rows for the pairing family (plane-stack operands)."""
+    if s_rows % SUBLANES:
+        raise ValueError(f"S={s_rows} rows not a multiple of {SUBLANES}")
+    if budget is None:
+        budget = budget_bytes()
+    best = 0
+    tile = SUBLANES
+    while tile <= s_rows:
+        if s_rows % tile == 0 and \
+                pairing_step_footprint_bytes(n_in_planes, n_out_planes,
+                                             tile, with_digits) <= budget:
+            best = tile
+        tile += SUBLANES
+    if not best:
+        need = pairing_step_footprint_bytes(n_in_planes, n_out_planes,
+                                            SUBLANES, with_digits)
+        raise ValueError(
+            f"pallas_pairing kernel with {n_in_planes}+{n_out_planes} "
+            f"planes needs {need} B of scoped VMEM at the minimum 8-row "
+            f"tile, over the {budget} B budget ({_BUDGET_ENV} to raise "
+            f"it; hard limit {HARD_LIMIT_BYTES} B)")
+    return best
+
+
 def pick_tile_rows(n_point_inputs: int, s_rows: int,
                    with_digits: bool = True,
                    budget: int | None = None) -> int:

@@ -330,6 +330,17 @@ def backend_name() -> str:
     return _current.name
 
 
+def verify_path(n: int = 2048) -> str:
+    """Which pairing implementation `batch_verify` takes for an n-entry
+    batch on the active scheme/backend — surfaced in /metrics by
+    core.verify's BatchVerifier so operators can see whether the fused
+    pallas RLC path (or a fallback) is actually serving verifies."""
+    if _scheme == "insecure-test":
+        return "insecure-test"
+    path_fn = getattr(_current, "verify_path", None)
+    return path_fn(n) if path_fn is not None else _current.name
+
+
 # ---------------------------------------------------------------------------
 # Insecure test scheme — pipeline tests only.
 #

@@ -57,6 +57,35 @@ def test_pedersen_rejects_bad_share():
         keygen.pedersen_round2(1, n, bcasts, shares)
 
 
+def test_share_proofs_batch_verify():
+    """Share possession proofs (the BASELINE config-5 workload): every
+    (validator, share) proof across a multi-validator ceremony verifies
+    in ONE tbls.batch_verify call against the Feldman-derived pubshares;
+    a forged proof and a proof under the wrong transcript are isolated
+    without poisoning the rest of the batch."""
+    transcript = b"\x11" * 32
+    items, flip_at = [], 3
+    for v in range(3):                       # 3 validators, 2-of-3 each
+        gpk, shares, pubshares = keygen.keycast_deal(2, 3)
+        for idx, share in shares.items():
+            proof = keygen.share_proof(share, transcript)
+            items.append((pubshares[idx], proof))
+    good = keygen.verify_share_proofs(items, transcript)
+    assert good == [True] * len(items)
+    # forge one proof; verify under a different transcript rejects all
+    bad_items = list(items)
+    bad_items[flip_at] = (bad_items[flip_at][0], b"\x00" * 96)
+    got = keygen.verify_share_proofs(bad_items, transcript)
+    assert got == [k != flip_at for k in range(len(items))]
+    assert not any(keygen.verify_share_proofs(items, b"\x22" * 32))
+
+
+def test_share_proof_msg_is_domain_separated():
+    assert keygen.share_proof_msg(b"t1") != keygen.share_proof_msg(b"t2")
+    assert keygen.share_proof_msg(b"t1").startswith(
+        keygen._SHARE_PROOF_DST)
+
+
 def _run_ceremony(tmp_path, algorithm: str):
     n, t, m = 3, 2, 2
     ports = free_ports(n)

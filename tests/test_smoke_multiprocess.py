@@ -6,9 +6,16 @@ mesh between them, real HTTP to a shared beacon mock in the test process),
 booted from `create cluster` artifacts on disk.  Asserts threshold-signed
 duties arrive at the BN and that the cluster survives one node down
 (t-of-n degradation, the 1-of-4-down scenario).
+
+Startup synchronisation is READINESS-DRIVEN, not sleep-driven: each node
+gets an explicit monitoring port and the test polls its /readyz (quorum
+peers reachable AND beacon synced) before starting the duty deadline —
+on a loaded CI box the old fixed sleeps either wasted seconds or fired
+before the mesh converged and flaked the attestation assertion.
 """
 
 import asyncio
+import http.client
 import os
 import random
 import signal
@@ -24,6 +31,7 @@ from charon_tpu.eth2util.signing import DomainName, signing_root
 from charon_tpu.tbls import api as tbls
 from charon_tpu.testutil.beaconmock import BeaconMock
 from charon_tpu.testutil.beaconmock_http import BeaconMockServer
+from tests.test_p2p import free_ports
 
 N, T, M = 3, 2, 1
 SLOT_DUR = 1.0
@@ -36,6 +44,40 @@ def insecure_scheme():
     tbls.set_scheme("insecure-test")
     yield
     tbls.set_scheme("bls")
+
+
+def _readyz(port: int) -> tuple[bool, str]:
+    """One /readyz probe against a node's monitoring API."""
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        conn.request("GET", "/readyz")
+        resp = conn.getresponse()
+        body = resp.read().decode(errors="replace")
+        conn.close()
+        return resp.status == 200, body
+    except OSError as exc:
+        return False, str(exc)
+
+
+async def _await_ready(ports, procs, deadline: float) -> None:
+    """Poll every node's /readyz until all report ready (quorum peers
+    reachable AND beacon synced) — the reference's monitoring-API
+    readiness contract, instead of a fixed boot sleep."""
+    pending = dict(ports)
+    while pending:
+        for p in procs:
+            assert p.poll() is None, (
+                "node process died during startup:\n"
+                + p.stdout.read().decode(errors="replace")[-2000:])
+        for node, port in list(pending.items()):
+            if _readyz(port)[0]:
+                del pending[node]
+        if not pending:
+            return
+        if time.time() >= deadline:
+            reasons = {n: _readyz(p)[1] for n, p in pending.items()}
+            raise AssertionError(f"nodes never became ready: {reasons}")
+        await asyncio.sleep(0.2)
 
 
 def test_smoke_subprocess_cluster(tmp_path):
@@ -64,9 +106,8 @@ def test_smoke_subprocess_cluster(tmp_path):
                    JAX_PLATFORMS="cpu",
                    CHARON_TPU_TBLS_SCHEME="insecure-test")
         procs = []
-        # n-1 nodes only: one node down from the start — threshold still met
-        # (reference smoke partial-failure scenario)
-        for i in range(N - 1):
+        mon_ports = dict(enumerate(free_ports(N)))  # verified-free ports
+        for i in range(N):
             node_dir = os.path.join(cluster_dir, f"node{i}")
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "charon_tpu", "run",
@@ -75,7 +116,7 @@ def test_smoke_subprocess_cluster(tmp_path):
                  os.path.join(node_dir, "charon-enr-private-key"),
                  "--beacon-node-endpoints", server.addr,
                  "--validator-api-address", "127.0.0.1:0",
-                 "--monitoring-address", "127.0.0.1:0",
+                 "--monitoring-address", f"127.0.0.1:{mon_ports[i]}",
                  "--simnet-validator-mock",
                  "--tbls-scheme", "insecure-test"],
                 env=env, cwd=os.path.dirname(os.path.dirname(
@@ -83,16 +124,24 @@ def test_smoke_subprocess_cluster(tmp_path):
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
 
         try:
+            # readiness first: /readyz green on every node means the mesh
+            # has quorum and the BN is synced — only then does the duty
+            # clock start (no boot-time sleep to mistune under load)
+            await _await_ready(mon_ports, procs, time.time() + 60)
+            # 1-of-n-down degradation: kill the last node AFTER readiness;
+            # t-of-n must keep producing threshold-signed duties
+            procs[-1].send_signal(signal.SIGTERM)
+            live = procs[:-1]
+            seen_before_kill = len(bmock.attestations)
             deadline = time.time() + 60
-            while time.time() < deadline:
-                await asyncio.sleep(0.5)
-                for p in procs:
+            while len(bmock.attestations) <= seen_before_kill:
+                assert time.time() < deadline, \
+                    "no attestations after node-down within the deadline"
+                for p in live:
                     assert p.poll() is None, (
                         "node process died:\n"
                         + p.stdout.read().decode(errors="replace")[-2000:])
-                if bmock.attestations:
-                    await asyncio.sleep(2 * SLOT_DUR)
-                    break
+                await asyncio.sleep(0.2)
         finally:
             for p in procs:
                 p.send_signal(signal.SIGTERM)

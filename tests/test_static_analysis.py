@@ -29,21 +29,30 @@ EXPECTED_G2 = {f"pallas_g2.{n}" for n in
                ("dbl", "add", "addsel", "dblsel", "addsel_s", "dbl3sel_s")}
 EXPECTED_FP = {f"pallas_fp.{n}" for n in
                ("mul", "add", "sub", "neg", "mul_small[12]")}
+EXPECTED_PAIRING = {f"pallas_pairing.{n}" for n in
+                    ("pp_dbl", "pp_add", "pp_sqr", "pp_mul014",
+                     "pp_f12mul", "pp_g1_dblsel")}
 
 
 def test_registry_population():
     """Every pallas kernel, the backend workload shapes (including the
-    V=10k/T=7 bench shape), and the shard program are registered — a new
-    kernel without a registration line fails here."""
+    V=10k/T=7 bench shape and the batch-2048 verify shape), and the
+    shard program are registered — a new kernel without a registration
+    line fails here."""
     registry.ensure_populated()
     names = {k.name for k in registry.kernels()}
     assert EXPECTED_G2 <= names and EXPECTED_FP <= names
+    assert EXPECTED_PAIRING <= names
     vt = {(s.v, s.t) for s in registry.workload_shapes("g2")}
     assert (10_000, 7) in vt and (1, 1) in vt
     origins = {s.origin for s in registry.workload_shapes("g2")}
     assert origins == {"fused", "sharded"}
+    assert {s.v for s in registry.workload_shapes("pairing")} >= {2048}
     progs = {p.name for p in registry.shard_programs()}
     assert "backend_tpu.straus_combine_sharded" in progs
+    # the pairing TRACE_SET names every registered pairing kernel, so the
+    # bench preflight and the CLI cover the whole family
+    assert set(TRACE_SETS["pairing"]) == EXPECTED_PAIRING
 
 
 def test_arithmetic_audit_clean_for_every_registered_shape():
