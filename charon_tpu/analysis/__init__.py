@@ -25,6 +25,10 @@ passes that need no TPU attached:
    fori_loop/scan carry is checked for the round-5 `pvary` bug class —
    a replicated (device-invariant) carry init whose body output is
    device-varying.
+4. **Metric-name lint** (`metrics_lint`): every registry call site
+   (``inc``/``set_gauge``/``observe``) must pass a snake_case string
+   literal with a ``charon_tpu_``/``core_``/``app_`` prefix, one metric
+   type per name, no histogram-expansion collisions.
 
 Run it as ``python -m charon_tpu.analysis`` (exit 0 iff every contract
 holds), as a tier-1 test (tests/test_static_analysis.py), as the

@@ -34,6 +34,8 @@ def main(argv=None) -> int:
                          "(grid arithmetic always covers all)")
     ap.add_argument("--no-shard", action="store_true",
                     help="skip the shard-carry pass")
+    ap.add_argument("--no-metrics-lint", action="store_true",
+                    help="skip the metric-name lint pass")
     ap.add_argument("--shapes", default=None,
                     help="comma-separated VxT list overriding the "
                          "registered workload shapes, e.g. 10000x7,1024x2")
@@ -78,7 +80,8 @@ def main(argv=None) -> int:
             shapes = [tuple(int(x) for x in part.split("x"))
                       for part in args.shapes.split(",")]
         report = run_audit(shapes=shapes, trace=args.trace,
-                           shard=not args.no_shard, n_dev=args.devices)
+                           shard=not args.no_shard, n_dev=args.devices,
+                           metrics=not args.no_metrics_lint)
 
     if args.json:
         # stdout stays parseable JSON; the human summary goes to stderr

@@ -66,6 +66,7 @@ class AuditReport:
     kernels: list = field(default_factory=list)
     shard_cases: list = field(default_factory=list)
     shapes_checked: list = field(default_factory=list)
+    metrics_lint: object = None  # metrics_lint.MetricsLintReport | None
 
     @property
     def violations(self) -> list:
@@ -74,6 +75,8 @@ class AuditReport:
             out += k.violations
         for s in self.shard_cases:
             out += s.violations
+        if self.metrics_lint is not None:
+            out += self.metrics_lint.violations
         return out
 
     @property
@@ -86,6 +89,8 @@ class AuditReport:
             "shapes_checked": self.shapes_checked,
             "kernels": [asdict(k) for k in self.kernels],
             "shard_cases": [asdict(s) for s in self.shard_cases],
+            "metrics_lint": (self.metrics_lint.to_dict()
+                             if self.metrics_lint is not None else None),
             "violations": self.violations,
         }
 
@@ -108,6 +113,8 @@ class AuditReport:
             verdict = "ok" if not s.violations else "FAIL"
             lines.append(f"  [{verdict}] {s.name}: "
                          f"{s.carries_checked} loop carries checked")
+        if self.metrics_lint is not None:
+            lines.append(self.metrics_lint.summary())
         for v in self.violations:
             lines.append(f"  VIOLATION: {v}")
         status = "PASS" if self.ok else "FAIL"
@@ -242,7 +249,8 @@ def _shape_s_rows(family: str, shapes=None):
 
 def run_audit(shapes=None, trace: str = "all", shard: bool = True,
               n_dev: int | None = None, tolerance=None,
-              shard_retrace: bool = True) -> AuditReport:
+              shard_retrace: bool = True,
+              metrics: bool = True) -> AuditReport:
     """Run the kernel contract audit.
 
     shapes : optional [(V, T), ...] overriding the registered workload
@@ -254,9 +262,15 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
              programs on the local device mesh.
     shard_retrace : also re-trace each shard program with replication
              checking on (see shard_audit.audit_shard_case).
+    metrics : run the metric-name lint over the package source (pure
+             AST, sub-second — on in every audit surface).
     """
     registry.ensure_populated()
     report = AuditReport()
+    if metrics:
+        from .metrics_lint import lint_package
+
+        report.metrics_lint = lint_package()
 
     s_rows_map = _shape_s_rows("g2", shapes)
     pairing_map = _shape_s_rows("pairing", shapes)

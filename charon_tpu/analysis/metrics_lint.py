@@ -1,0 +1,166 @@
+"""Metric-name lint — static pass over every registry call site.
+
+The monitoring Registry creates series dynamically from string literals,
+so a typo'd or convention-breaking metric name ships silently and only
+shows up when a dashboard query returns nothing.  This pass walks the
+`charon_tpu` package AST, collects every string literal passed as the
+first argument to ``inc`` / ``set_gauge`` / ``observe`` (the Registry
+write surface), and fails on:
+
+- names that are not ``snake_case`` (``^[a-z][a-z0-9_]*$``),
+- names missing a ``charon_tpu_`` / ``core_`` / ``app_`` subsystem
+  prefix,
+- names used with more than one metric TYPE (e.g. the same name as both
+  a counter and a histogram — Prometheus scrapes reject the collision,
+  and a histogram's ``_bucket``/``_sum``/``_count`` expansion colliding
+  with a counter of the same stem is the sneaky variant),
+- histogram/counter stem collisions: a histogram ``X`` expands to
+  ``X_bucket``/``X_sum``/``X_count`` series, so another metric named
+  ``X_count`` (etc.) collides at scrape time.
+
+Runs inside ``python -m charon_tpu.analysis`` (every audit includes it)
+and tier-1 (tests/test_static_analysis.py).  Pure AST — no imports of
+the scanned modules, sub-second.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+#: Registry write methods → the metric type they create.
+METRIC_METHODS = {"inc": "counter", "set_gauge": "gauge",
+                  "observe": "histogram"}
+
+ALLOWED_PREFIXES = ("charon_tpu_", "core_", "app_")
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: The Registry implementation itself dispatches sample values through
+#: methods with the same names (`_Hist.observe(value)`) — implementation,
+#: not call sites.
+EXCLUDE_FILES = ("app/monitoring.py",)
+
+
+@dataclass
+class MetricSite:
+    file: str
+    line: int
+    name: str
+    kind: str  # counter | gauge | histogram
+
+
+@dataclass
+class MetricsLintReport:
+    sites: list = field(default_factory=list)
+    violations: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def names(self) -> dict[str, set]:
+        out: dict[str, set] = {}
+        for s in self.sites:
+            out.setdefault(s.name, set()).add(s.kind)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "metrics": {n: sorted(k) for n, k in sorted(self.names().items())},
+            "violations": self.violations,
+        }
+
+    def summary(self) -> str:
+        status = "PASS" if self.ok else "FAIL"
+        return (f"  [{'ok' if self.ok else 'FAIL'}] metric-name lint: "
+                f"{len(self.names())} metrics at {len(self.sites)} call "
+                f"sites — {status}")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, report: MetricsLintReport):
+        self._path = path
+        self._report = report
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in METRIC_METHODS:
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self._report.sites.append(MetricSite(
+                    file=self._path, line=node.lineno, name=arg.value,
+                    kind=METRIC_METHODS[fn.attr]))
+            elif arg is not None and not isinstance(arg, ast.Constant):
+                # a computed metric name defeats static linting — flag it
+                # so dynamic names stay a deliberate, reviewed exception
+                self._report.violations.append(
+                    f"{self._path}:{node.lineno}: non-literal metric name "
+                    f"passed to {fn.attr}() — metric names must be string "
+                    f"literals so the lint (and grep) can see them")
+        self.generic_visit(node)
+
+
+def lint_sources(sources: dict[str, str]) -> MetricsLintReport:
+    """Lint {path: python source} — the unit-testable core."""
+    report = MetricsLintReport()
+    for path, src in sorted(sources.items()):
+        if path.replace(os.sep, "/").endswith(EXCLUDE_FILES):
+            continue
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as exc:  # pragma: no cover - repo parses
+            report.violations.append(f"{path}: unparseable: {exc}")
+            continue
+        _Visitor(path, report).visit(tree)
+
+    for site in report.sites:
+        where = f"{site.file}:{site.line}"
+        if not _SNAKE.match(site.name):
+            report.violations.append(
+                f"{where}: metric {site.name!r} is not snake_case")
+        if not site.name.startswith(ALLOWED_PREFIXES):
+            report.violations.append(
+                f"{where}: metric {site.name!r} lacks a subsystem prefix "
+                f"{ALLOWED_PREFIXES}")
+
+    names = report.names()
+    for name, kinds in sorted(names.items()):
+        if len(kinds) > 1:
+            report.violations.append(
+                f"metric {name!r} is used as more than one type: "
+                f"{sorted(kinds)} — one name, one type")
+    # histogram expansion collisions: histogram X owns X_bucket/_sum/_count
+    hist_stems = {n for n, k in names.items() if "histogram" in k}
+    for stem in sorted(hist_stems):
+        for suffix in _HIST_SUFFIXES:
+            if stem + suffix in names:
+                report.violations.append(
+                    f"metric {stem + suffix!r} collides with histogram "
+                    f"{stem!r}'s {suffix} series")
+    return report
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_package(root: str | None = None) -> MetricsLintReport:
+    """Lint every .py file under the charon_tpu package (tests and
+    scripts outside the package define scratch registries freely)."""
+    root = root or package_root()
+    sources: dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    sources[os.path.relpath(path, os.path.dirname(root))] = \
+                        f.read()
+    return lint_sources(sources)

@@ -1,28 +1,84 @@
-"""Monitoring API: /metrics (Prometheus text format), /livez, /readyz.
+"""Monitoring API: /metrics (Prometheus text format), /livez, /readyz,
+plus the profiling/debug endpoints.
 
-Mirrors reference app/monitoringapi.go:48-176: readiness = quorum of peers
+Mirrors reference app/monitoringapi.go:48-176 (readiness = quorum of peers
 reachable AND beacon node synced; metrics registry with cluster-identity
-labels (reference: app/promauto wrapping, app/app.go:198-207).  Plain
-asyncio HTTP — no external web framework.
+labels, app/promauto wrapping) and app/monitoringapi.go:84-88 (pprof):
+
+- ``/metrics``            Prometheus text format 0.0.4 (fixed-bucket
+                          histograms — ``_bucket{le=...}``/``_sum``/
+                          ``_count`` — not unbounded sample lists)
+- ``/livez`` ``/readyz`` ``/enr``
+- ``/debug/qbft``         sniffed QBFT instance ring (JSON)
+- ``/debug/spans``        the tracer's recent span ring as OTLP JSON
+- ``/debug/memory``       jax.live_arrays / device memory stats /
+                          decompressed-pubkey cache size (JSON)
+- ``/debug/profile?seconds=N``  captures a ``jax.profiler`` device trace
+                          and streams it back as a gzipped tarball — the
+                          pprof equivalent for the TPU hot path
+
+Plain asyncio HTTP — no external web framework.
 """
 
 from __future__ import annotations
 
 import asyncio
+import io
+import json
+import shutil
+import sys
+import tarfile
+import tempfile
 import time
+import urllib.parse
 from collections import defaultdict
 from typing import Callable
 
+#: Default histogram bounds (seconds-scale latency): per-metric overrides
+#: via Registry.set_buckets.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _Hist:
+    """One histogram series: fixed cumulative buckets + sum + count.
+    O(1) memory per series regardless of sample volume (the previous
+    implementation appended every sample to a list forever)."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: tuple):
+        self.bounds = bounds
+        self.counts = [0] * len(bounds)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, le in enumerate(self.bounds):
+            if value <= le:
+                self.counts[i] += 1  # per-bin; render accumulates
+                break
+
+    def cumulative(self) -> list:
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
 
 class Registry:
-    """Minimal Prometheus-style registry: counters + gauges + histograms
-    with cluster-identity constant labels."""
+    """Minimal Prometheus-style registry: counters + gauges + fixed-bucket
+    histograms with cluster-identity constant labels."""
 
     def __init__(self, const_labels: dict | None = None):
         self.const_labels = dict(const_labels or {})
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
-        self._hist: dict[tuple, list[float]] = defaultdict(list)
+        self._hist: dict[tuple, _Hist] = {}
+        self._buckets: dict[str, tuple] = {}
 
     def _key(self, name: str, labels: dict | None) -> tuple:
         merged = {**self.const_labels, **(labels or {})}
@@ -36,9 +92,19 @@ class Registry:
                   labels: dict | None = None) -> None:
         self._gauges[self._key(name, labels)] = value
 
+    def set_buckets(self, name: str, bounds) -> None:
+        """Per-metric bucket config; applies to series created after the
+        call (configure at wiring time, before the first observe)."""
+        self._buckets[name] = tuple(sorted(float(b) for b in bounds))
+
     def observe(self, name: str, value: float,
                 labels: dict | None = None) -> None:
-        self._hist[self._key(name, labels)].append(value)
+        key = self._key(name, labels)
+        h = self._hist.get(key)
+        if h is None:
+            h = self._hist[key] = _Hist(
+                self._buckets.get(name, DEFAULT_BUCKETS))
+        h.observe(value)
 
     def render(self) -> str:
         lines = []
@@ -46,38 +112,67 @@ class Registry:
             lines.append(f"{name}{_fmt_labels(labels)} {v}")
         for (name, labels), v in sorted(self._gauges.items()):
             lines.append(f"{name}{_fmt_labels(labels)} {v}")
-        for (name, labels), values in sorted(self._hist.items()):
-            n = len(values)
-            total = sum(values)
-            lines.append(f"{name}_count{_fmt_labels(labels)} {n}")
-            lines.append(f"{name}_sum{_fmt_labels(labels)} {total}")
-            if n:
-                s = sorted(values)
-                for q in (0.5, 0.9, 0.99):
-                    idx = min(n - 1, int(q * n))
-                    lines.append(
-                        f"{name}{_fmt_labels(labels + (('quantile', str(q)),))}"
-                        f" {s[idx]}")
+        typed = set()
+        for (name, labels), h in sorted(self._hist.items()):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} histogram")
+            for le, acc in zip(h.bounds, h.cumulative()):
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_fmt_labels(labels + (('le', _fmt_le(le)),))} {acc}")
+            lines.append(
+                f"{name}_bucket"
+                f"{_fmt_labels(labels + (('le', '+Inf'),))} {h.count}")
+            lines.append(f"{name}_sum{_fmt_labels(labels)} {h.sum}")
+            lines.append(f"{name}_count{_fmt_labels(labels)} {h.count}")
         return "\n".join(lines) + "\n"
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus renders integral bounds without the trailing .0."""
+    return str(int(bound)) if float(bound).is_integer() else repr(bound)
+
+
+def _escape_label(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _fmt_labels(labels: tuple) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
+#: Prometheus text-format 0.0.4 content type — what real scrapers
+#: negotiate for (reference: promhttp's Content-Type).
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+PROFILE_MAX_SECONDS = 30.0
+
+#: jax.profiler trace state is PROCESS-global, so the in-flight guard
+#: must be too: with several in-process nodes (simnet), concurrent
+#: /debug/profile requests to different nodes' APIs still race one
+#: profiler.
+_PROFILE_ACTIVE = False
+
+
 class MonitoringAPI:
-    """Serves /metrics, /livez, /readyz, /enr over plain HTTP/1.0."""
+    """Serves /metrics, /livez, /readyz, /enr and the /debug endpoints
+    over plain HTTP/1.0."""
 
     def __init__(self, registry: Registry,
                  readyz: Callable[[], tuple[bool, str]],
-                 identity: str = "", qbft_debug: Callable[[], bytes] = None):
+                 identity: str = "", qbft_debug: Callable[[], bytes] = None,
+                 tracer=None, memory_extra: Callable[[], dict] = None):
         self.registry = registry
         self._readyz = readyz
         self._identity = identity
         self._qbft_debug = qbft_debug  # app.qbftdebug ring renderer
+        self._tracer = tracer          # app.tracing.Tracer (/debug/spans)
+        self._memory_extra = memory_extra  # app-specific /debug/memory dict
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
 
@@ -94,14 +189,16 @@ class MonitoringAPI:
         try:
             request = await asyncio.wait_for(reader.readline(), 5.0)
             parts = request.decode().split()
-            path = parts[1] if len(parts) > 1 else "/"
+            target = parts[1] if len(parts) > 1 else "/"
             while True:  # drain headers
                 line = await asyncio.wait_for(reader.readline(), 5.0)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            status, body = self._route(path)
+            path, _, query = target.partition("?")
+            status, ctype, body = await self._route(
+                path, urllib.parse.parse_qs(query))
             writer.write(
-                f"HTTP/1.0 {status}\r\nContent-Type: text/plain\r\n"
+                f"HTTP/1.0 {status}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
             await writer.drain()
         except (asyncio.TimeoutError, ConnectionError):
@@ -109,18 +206,128 @@ class MonitoringAPI:
         finally:
             writer.close()
 
-    def _route(self, path: str) -> tuple[str, bytes]:
+    async def _route(self, path: str,
+                     query: dict) -> tuple[str, str, bytes]:
+        text, js = "text/plain", "application/json"
         if path == "/metrics":
-            return "200 OK", self.registry.render().encode()
+            return ("200 OK", METRICS_CONTENT_TYPE,
+                    self.registry.render().encode())
         if path == "/livez":
-            return "200 OK", b"ok"
+            return "200 OK", text, b"ok"
         if path == "/readyz":
             ok, reason = self._readyz()
-            return ("200 OK", b"ok") if ok else (
-                "503 Service Unavailable", reason.encode())
+            return ("200 OK", text, b"ok") if ok else (
+                "503 Service Unavailable", text, reason.encode())
         if path == "/enr":
-            return "200 OK", self._identity.encode()
+            return "200 OK", text, self._identity.encode()
         if path == "/debug/qbft" and self._qbft_debug is not None:
             # reference: app/qbftdebug.go:35-122 sniffed-instance dump
-            return "200 OK", self._qbft_debug()
-        return "404 Not Found", b"not found"
+            return "200 OK", js, self._qbft_debug()
+        if path == "/debug/spans" and self._tracer is not None:
+            return "200 OK", js, self._render_spans()
+        if path == "/debug/memory":
+            return "200 OK", js, self._render_memory()
+        if path == "/debug/profile":
+            return await self._profile(query)
+        return "404 Not Found", text, b"not found"
+
+    # -- /debug/spans -------------------------------------------------------
+
+    def _render_spans(self) -> bytes:
+        """The recent span ring as one OTLP/JSON export request."""
+        from . import otlp
+
+        spans = [s for s in self._tracer.spans if s.end is not None]
+        doc = otlp.export_request(spans, resource_attrs={
+            **self.registry.const_labels,
+            "dropped_spans": self._tracer.dropped})
+        return json.dumps(doc).encode()
+
+    # -- /debug/memory ------------------------------------------------------
+
+    def _render_memory(self) -> bytes:
+        """Device/host memory stats: jax.live_arrays, per-device memory
+        stats where the backend exposes them, and the TPU backend's
+        decompressed-pubkey / hashed-message cache sizes."""
+        info: dict = {}
+        try:
+            import jax
+
+            arrs = jax.live_arrays()
+            nbytes = 0
+            for a in arrs:
+                try:
+                    nbytes += a.nbytes
+                except Exception:  # deleted/donated buffers
+                    pass
+            info["live_arrays"] = len(arrs)
+            info["live_array_bytes"] = int(nbytes)
+            devs = []
+            for d in jax.local_devices():
+                devs.append({"id": d.id, "platform": d.platform,
+                             "memory_stats": d.memory_stats()})
+            info["devices"] = devs
+        except Exception as exc:  # pragma: no cover - no jax backend
+            info["error"] = f"{type(exc).__name__}: {exc}"
+        be = sys.modules.get("charon_tpu.tbls.backend_tpu")
+        if be is not None:
+            info["pubkey_cache_entries"] = len(be.TPUBackend._PK_CACHE)
+            info["pubkey_cache_hits"] = be.TPUBackend.pk_cache_hits
+            info["pubkey_cache_misses"] = be.TPUBackend.pk_cache_misses
+            info["hashed_msg_cache_entries"] = len(be.TPUBackend._HM_CACHE)
+        if self._tracer is not None:
+            info["tracer"] = {"spans_buffered": len(self._tracer.spans),
+                              "dropped_spans": self._tracer.dropped}
+        if self._memory_extra is not None:
+            try:
+                info.update(self._memory_extra())
+            except Exception as exc:  # noqa: BLE001 — debug must not 500
+                info["extra_error"] = f"{type(exc).__name__}: {exc}"
+        return json.dumps(info, indent=1, default=str).encode()
+
+    # -- /debug/profile -----------------------------------------------------
+
+    async def _profile(self, query: dict) -> tuple[str, str, bytes]:
+        """Capture a jax.profiler device trace for ?seconds=N (default 1,
+        capped) and stream the capture directory back as a gzipped
+        tarball — works on CPU (XLA host tracing) and TPU alike."""
+        try:
+            seconds = float(query.get("seconds", ["1"])[0])
+        except ValueError:
+            return ("400 Bad Request", "text/plain",
+                    b"seconds must be a number")
+        seconds = min(max(seconds, 0.0), PROFILE_MAX_SECONDS)
+        global _PROFILE_ACTIVE
+        if _PROFILE_ACTIVE:
+            return ("409 Conflict", "text/plain",
+                    b"a profile capture is already running")
+        try:
+            import jax
+        except Exception:  # pragma: no cover - no jax in process
+            return ("501 Not Implemented", "text/plain", b"jax unavailable")
+        _PROFILE_ACTIVE = True
+        tmp = tempfile.mkdtemp(prefix="charon-tpu-profile-")
+        try:
+            jax.profiler.start_trace(tmp)
+            try:
+                deadline = time.monotonic() + seconds
+                while time.monotonic() < deadline:
+                    await asyncio.sleep(
+                        min(0.1, max(deadline - time.monotonic(), 0)))
+                # a token device op so an idle node still yields a
+                # non-empty capture (and the device plane appears)
+                import jax.numpy as jnp
+
+                (jnp.arange(128, dtype=jnp.int32) + 1).block_until_ready()
+            finally:
+                jax.profiler.stop_trace()
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                tar.add(tmp, arcname="profile")
+            return "200 OK", "application/octet-stream", buf.getvalue()
+        except Exception as exc:  # noqa: BLE001 — debug must not crash node
+            return ("500 Internal Server Error", "text/plain",
+                    f"profile capture failed: {exc}".encode())
+        finally:
+            _PROFILE_ACTIVE = False
+            shutil.rmtree(tmp, ignore_errors=True)

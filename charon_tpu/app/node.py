@@ -15,15 +15,18 @@ from dataclasses import dataclass, field
 from ..core import interfaces
 from ..core.aggsigdb import MemAggSigDB
 from ..core.bcast import Broadcaster, Recaster
+from ..core.deadline import Deadliner, duty_deadline
 from ..core.dutydb import MemDutyDB
 from ..core.fetcher import Fetcher
 from ..core.parsigdb import MemParSigDB
 from ..core.scheduler import Scheduler
 from ..core.sigagg import SigAgg
+from ..core.tracker import Tracker
 from ..core.types import Duty, ParSignedDataSet, PubKey
 from ..core.validatorapi import ValidatorAPI
 from ..core.verify import BatchVerifier
 from ..eth2util.signing import signing_root
+from .tracing import Tracer, with_tracing
 
 
 @dataclass
@@ -41,9 +44,16 @@ class Node:
 
     def __init__(self, cfg: NodeConfig, eth2cl, consensus, parsigex,
                  slots_per_epoch: int = 16, genesis_time: float = 0.0,
-                 slot_duration: float = 1.0):
+                 slot_duration: float = 1.0, registry=None, tracer=None):
         self.cfg = cfg
         self.eth2cl = eth2cl
+        # Observability rides the in-memory simnet node exactly like the
+        # full App: every node gets a Tracer (deterministic duty trace
+        # IDs join across nodes), and passing a monitoring Registry also
+        # wires a Tracker + Deadliner GC so per-peer participation and
+        # inclusion delay reach /metrics without the TCP/crypto stack.
+        self.registry = registry
+        self.tracer = tracer if tracer is not None else Tracer(registry)
 
         pubshares = cfg.pubshares_by_peer[cfg.share_idx]
         self.scheduler = Scheduler(eth2cl, list(pubshares),
@@ -55,7 +65,7 @@ class Node:
         # partials) share one micro-batching verifier → one
         # tbls.batch_verify launch per event-loop tick (reference per-sig
         # call-sites: validatorapi.go:1052-1068, parsigex.go:152-176).
-        self.verifier = BatchVerifier()
+        self.verifier = BatchVerifier(tracer=self.tracer)
         self.vapi = ValidatorAPI(
             share_idx=cfg.share_idx,
             pubshare_by_group=pubshares,
@@ -69,21 +79,50 @@ class Node:
         # declare the hook but have none set.
         if getattr(parsigex, "_verify_fn", True) is None:
             parsigex._verify_fn = self._verify_external
-        self.sigagg = SigAgg(cfg.threshold)
+        self.sigagg = SigAgg(cfg.threshold, tracer=self.tracer)
         self.aggsigdb = MemAggSigDB()
-        self.bcast = Broadcaster(eth2cl, genesis_time, slot_duration)
+        self.bcast = Broadcaster(eth2cl, genesis_time, slot_duration,
+                                 registry=registry)
         self.recaster = Recaster()
         self._spe = slots_per_epoch
+        self._genesis_time = genesis_time
+        self._slot_duration = slot_duration
 
         interfaces.wire(self.scheduler, self.fetcher, self.consensus,
                         self.dutydb, self.vapi, self.parsigdb, self.parsigex,
-                        self.sigagg, self.aggsigdb, self.bcast)
+                        self.sigagg, self.aggsigdb, self.bcast,
+                        with_tracing(self.tracer))
         # recaster rides the sigagg + slot events (reference: app/app.go:462)
         self.sigagg.subscribe(self.recaster.store)
         self.scheduler.subscribe_slots(self.recaster.slot_ticked)
         self.recaster.subscribe(self.bcast.broadcast)
 
+        self.tracker: Tracker | None = None
+        self.deadliner: Deadliner | None = None
+        if registry is not None:
+            self.tracker = Tracker(
+                num_peers=len(cfg.pubshares_by_peer),
+                threshold=cfg.threshold, registry=registry,
+                slot_start_fn=lambda slot: (genesis_time
+                                            + slot * slot_duration))
+            self.scheduler.subscribe_duties(self.tracker.on_duty_scheduled)
+            self.fetcher.subscribe(self.tracker.on_fetched)
+            if hasattr(consensus, "subscribe"):
+                consensus.subscribe(self.tracker.on_consensus)
+            self.parsigdb.subscribe_internal(self.tracker.on_parsig_internal)
+            parsigex.subscribe(self.tracker.on_parsig_external)
+            self.parsigdb.subscribe_threshold(self.tracker.on_threshold)
+            self.sigagg.subscribe(self.tracker.on_aggregated)
+
+            async def _register_deadline(duty: Duty, *_args) -> None:
+                if self.deadliner is not None:
+                    self.deadliner.add(duty)
+
+            self.scheduler.subscribe_duties(_register_deadline)
+            parsigex.subscribe(_register_deadline)
+
         self._run_task: asyncio.Task | None = None
+        self._gc_task: asyncio.Task | None = None
 
     async def _verify_external(self, duty: Duty,
                                pset: ParSignedDataSet) -> None:
@@ -103,11 +142,33 @@ class Node:
         if not all(await self.verifier.verify_many(entries)):
             raise ValueError("invalid external partial signature")
 
+    async def _gc_loop(self) -> None:
+        """Duty-expiry GC + post-deadline tracker analysis (the App's
+        `_gc_loop`, scaled down to the in-memory node)."""
+        async for duty in self.deadliner.expired():
+            self.dutydb.trim(duty)
+            self.parsigdb.trim(duty)
+            self.aggsigdb.trim(duty)
+            if hasattr(self.consensus, "trim"):
+                self.consensus.trim(duty)
+            self.scheduler.trim(duty)
+            await self.tracker.analyse(duty)
+
     def start(self) -> None:
-        self._run_task = asyncio.get_event_loop().create_task(
-            self.scheduler.run())
+        loop = asyncio.get_event_loop()
+        self._run_task = loop.create_task(self.scheduler.run())
+        if self.tracker is not None:
+            self.deadliner = Deadliner(
+                lambda d: duty_deadline(d, self._genesis_time,
+                                        self._slot_duration))
+            self.deadliner.start()
+            self._gc_task = loop.create_task(self._gc_loop())
 
     def stop(self) -> None:
         self.scheduler.stop()
         if self._run_task is not None:
             self._run_task.cancel()
+        if self.deadliner is not None:
+            self.deadliner.stop()
+        if self._gc_task is not None:
+            self._gc_task.cancel()

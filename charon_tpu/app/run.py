@@ -18,6 +18,7 @@ material on disk:
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -45,7 +46,7 @@ from ..p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
                              P2PPriorityExchange)
 from ..p2p.transport import TCPMesh, mesh_params_from_definition
 from ..tbls import api as tbls
-from . import featureset
+from . import featureset, otlp, tracing
 from .lifecycle import Manager, StartOrder, StopOrder
 from .monitoring import MonitoringAPI, Registry
 from .qbftdebug import QBFTSniffer
@@ -78,6 +79,11 @@ class RunConfig:
     features_disabled: list[str] = field(default_factory=list)
     ping_interval: float = 5.0
     peerinfo_interval: float = 10.0
+    # OTLP trace export (empty = fall back to CHARON_TPU_TRACE_FILE /
+    # CHARON_TPU_TRACE_ENDPOINT env vars; "{node}" in the file path
+    # expands to this node's name)
+    trace_file: str = ""
+    trace_endpoint: str = ""
 
 
 class App:
@@ -139,15 +145,43 @@ class App:
         fork = definition.fork_version
 
         # 5. metrics registry with cluster identity labels (app/app.go:198)
+        # node identity rides the "node" key: per-series "peer" labels
+        # (tracker participation, ping RTT) name the SUBJECT peer and
+        # must not overwrite the reporting node's identity in the merge
         self.registry.const_labels.update({
             "cluster_hash": cluster_hash.hex()[:10],
             "cluster_name": definition.name,
-            "peer": f"node{self_index}",
+            "node": f"node{self_index}",
         })
         self.registry.set_gauge("app_peers", n)
         self.registry.set_gauge("app_threshold", threshold)
         self.registry.set_gauge("app_validators",
                                 definition.num_validators)
+        # inclusion delay spans whole slots; the default sub-second
+        # latency buckets would clip it
+        self.registry.set_buckets(
+            "charon_tpu_tracker_inclusion_delay",
+            (0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+
+        # 5b. duty tracer + OTLP export sinks (reference: app/tracer/
+        #     trace.go:40-151).  The tracer is created before the core
+        #     components so the TPU boundary (BatchVerifier / SigAgg
+        #     launches, pk-cache misses) can span into it.
+        self.tracer_spans = Tracer(self.registry)
+        node_name = f"node{self_index}"
+        self._otlp_sinks = otlp.sinks_from_env(
+            resource_attrs={"service.name": "charon_tpu",
+                            "peer": node_name,
+                            "cluster_hash": cluster_hash.hex()[:10]},
+            registry=self.registry, node_name=node_name,
+            environ={**os.environ,
+                     **({"CHARON_TPU_TRACE_FILE": cfg.trace_file}
+                        if cfg.trace_file else {}),
+                     **({"CHARON_TPU_TRACE_ENDPOINT": cfg.trace_endpoint}
+                        if cfg.trace_endpoint else {})})
+        for sink in self._otlp_sinks:
+            self.tracer_spans.add_sink(sink)
+        tracing.set_global_tracer(self.tracer_spans)
 
         # 6. pubshare maps from the lock (app/app.go:327-376)
         pubshares_by_peer: dict[int, dict[PubKey, bytes]] = {
@@ -172,7 +206,8 @@ class App:
         # validatorapi.go:1052-1068) and inbound peer exchange (reference:
         # core/parsigex/parsigex.go:152-176) — coalesce into one
         # tbls.batch_verify device launch per event-loop tick.
-        self.verifier = BatchVerifier(on_launch=self._on_verify_launch)
+        self.verifier = BatchVerifier(on_launch=self._on_verify_launch,
+                                      tracer=self.tracer_spans)
         vapi = ValidatorAPI(share_idx=share_idx,
                             pubshare_by_group=pubshares,
                             fork_version=fork,
@@ -181,7 +216,7 @@ class App:
                             verifier=self.verifier)
         parsigdb = MemParSigDB(threshold)
         parsigex = P2PParSigEx(self.mesh, verify_fn=self._verify_external)
-        sigagg = SigAgg(threshold)
+        sigagg = SigAgg(threshold, tracer=self.tracer_spans)
         aggsigdb = MemAggSigDB()
         bcast = Broadcaster(self.eth2cl, self.genesis_time,
                             self.slot_duration,
@@ -193,7 +228,6 @@ class App:
         self.deadliner = Deadliner(deadline_fn)
         self.retryer = Retryer(deadline_fn)
 
-        self.tracer_spans = Tracer(self.registry)
         interfaces.wire(sched, fetcher, consensus, dutydb, vapi, parsigdb,
                         parsigex, sigagg, aggsigdb, bcast,
                         with_tracing(self.tracer_spans),
@@ -208,7 +242,10 @@ class App:
 
         # 8. tracker rides every edge as an extra subscriber
         #    (reference: app/app.go:450 wireTracker)
-        self.tracker = Tracker(num_peers=n, threshold=threshold)
+        self.tracker = Tracker(
+            num_peers=n, threshold=threshold, registry=self.registry,
+            slot_start_fn=lambda slot: (self.genesis_time
+                                        + slot * self.slot_duration))
         sched.subscribe_duties(self.tracker.on_duty_scheduled)
         fetcher.subscribe(self.tracker.on_fetched)
         consensus.subscribe(self.tracker.on_consensus)
@@ -243,7 +280,9 @@ class App:
                                  interval=cfg.peerinfo_interval)
         self.monitoring = MonitoringAPI(
             self.registry, self._readyz, identity=identity.enr(),
-            qbft_debug=self.qbft_sniffer.render_json)
+            qbft_debug=self.qbft_sniffer.render_json,
+            tracer=self.tracer_spans,
+            memory_extra=self._memory_extra)
 
         # 12. validator-API HTTP router (reverse proxy → first beacon URL)
         self._index_to_pubkey: dict[int, PubKey] = {}
@@ -315,6 +354,14 @@ class App:
                 "duty %s failed at %s: %s", report.duty,
                 report.failed_step, report.reason)
 
+    def _memory_extra(self) -> dict:
+        """App-specific /debug/memory rows beyond the jax/backend stats."""
+        return {
+            "aggsigdb_entries": len(getattr(self.aggsigdb, "_data", ())),
+            "tracker_pending_duties": len(self.tracker._events),
+            "verifier_launches": self.verifier.launches,
+        }
+
     def _readyz(self) -> tuple[bool, str]:
         """Quorum peers reachable AND beacon node synced
         (reference: app/monitoringapi.go:100-176)."""
@@ -367,7 +414,7 @@ class App:
                 try:
                     rtt = await self.mesh.ping(peer)
                     self._ping_ok[peer] = time.time()
-                    self.registry.observe("p2p_ping_rtt_seconds", rtt,
+                    self.registry.observe("app_p2p_ping_rtt_seconds", rtt,
                                           labels={"peer": str(peer)})
                 except Exception:
                     pass
@@ -433,6 +480,12 @@ class App:
     async def _stop_monitoring(self) -> None:
         await self.monitoring.stop()
         self.deadliner.stop()
+        for sink in self._otlp_sinks:
+            # final drain: FileSink flushes sync, AsyncHTTPSink async
+            if hasattr(sink, "aclose"):
+                await sink.aclose()
+            elif hasattr(sink, "close"):
+                sink.close()
 
     async def _stop_scheduler(self) -> None:
         self.scheduler.stop()

@@ -8,8 +8,10 @@ without any coordination.  Every core wire edge is wrapped in a span via
 the `with_tracing` wire option (composable with with_async_retry, like the
 reference's WithTracing).
 
-Spans are collected in-memory (exporters are pluggable sinks); the
-monitoring registry gets per-edge latency histograms for free.
+Spans are collected in a bounded ring (exporters are pluggable sinks —
+OTLP/JSON file + async HTTP exporters live in `app.otlp`); the monitoring
+registry gets per-edge latency histograms for free, plus a
+``charon_tpu_tracer_dropped_spans_total`` counter for ring evictions.
 """
 
 from __future__ import annotations
@@ -17,6 +19,7 @@ from __future__ import annotations
 import contextvars
 import hashlib
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..core.types import Duty
@@ -48,14 +51,23 @@ class Span:
 
 
 class Tracer:
-    """In-memory span collector with pluggable export sinks."""
+    """Span collector with a bounded ring buffer and pluggable sinks.
+
+    The ring (`max_spans` most recent) serves `/debug/spans` and
+    in-process assertions; export happens at span END via sinks, so a
+    full ring never loses exports — only the in-memory view rolls over.
+    Each eviction increments `dropped` (exported to the registry as
+    ``charon_tpu_tracer_dropped_spans_total``), replacing the old
+    silent drop-newest-forever behaviour."""
 
     def __init__(self, registry=None, max_spans: int = 16384):
-        self.spans: list[Span] = []
+        self.spans: deque[Span] = deque(maxlen=max_spans)
         self._registry = registry
         self._max = max_spans
         self._seq = 0
         self._sinks: list = []
+        self.dropped = 0
+        self.sink_errors = 0
 
     def add_sink(self, fn) -> None:
         """fn(span) called at span end (exporter hook)."""
@@ -74,17 +86,41 @@ class Tracer:
                     name=name,
                     parent_id=parent.span_id if parent is not None else None,
                     start=time.time(), attrs=dict(attrs))
-        if len(self.spans) < self._max:
-            self.spans.append(span)
+        if len(self.spans) == self._max:
+            # deque(maxlen) evicts the oldest span on append
+            self.dropped += 1
+            if self._registry is not None:
+                self._registry.inc("charon_tpu_tracer_dropped_spans_total")
+        self.spans.append(span)
         return SpanHandle(self, span)
 
     def _finish(self, span: Span) -> None:
         span.end = time.time()
-        if self._registry is not None:
-            self._registry.observe("app_span_duration_seconds",
-                                   span.duration, labels={"span": span.name})
+        # A failing exporter (full disk, missing trace dir, dead
+        # collector) is a telemetry problem, never a duty problem: the
+        # span-wrapped operation — a verify launch, a combine, a wire
+        # edge — must not inherit the exception.  Count + log once.
+        try:
+            if self._registry is not None:
+                self._registry.observe("app_span_duration_seconds",
+                                       span.duration,
+                                       labels={"span": span.name})
+        except Exception:
+            self._note_sink_error()
         for fn in self._sinks:
-            fn(span)
+            try:
+                fn(span)
+            except Exception:
+                self._note_sink_error()
+
+    def _note_sink_error(self) -> None:
+        self.sink_errors += 1
+        if self.sink_errors == 1:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "span export sink raised (counted, not re-raised; "
+                "further sink errors are logged at this counter only)")
 
     def trace(self, trace_id: str) -> list[Span]:
         return [s for s in self.spans if s.trace_id == trace_id]
@@ -105,6 +141,43 @@ class SpanHandle:
         if exc is not None:
             self.span.attrs["error"] = repr(exc)
         self._tracer._finish(self.span)
+
+
+# Process-global tracer hook for spans emitted below the app layer (the
+# tbls TPU backend's decompress-cache misses): the backend is a process
+# singleton, so its spans cannot belong to any one node's tracer — the
+# last app to install wins, which is exact for production (one node per
+# process) and an accepted approximation for in-process multi-node tests.
+_global_tracer: Tracer | None = None
+
+
+def set_global_tracer(tracer: Tracer | None) -> None:
+    global _global_tracer
+    _global_tracer = tracer
+
+
+def global_tracer() -> Tracer | None:
+    return _global_tracer
+
+
+class _NoopHandle:
+    """Context manager stand-in when no global tracer is installed."""
+
+    def __enter__(self) -> Span:
+        return Span(trace_id="", span_id="", name="", parent_id=None,
+                    start=time.time())
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        return None
+
+
+def device_span(name: str, **attrs):
+    """Span on the process-global tracer, or a no-op without one —
+    the TPU-boundary instrumentation hook for modules below app/."""
+    t = _global_tracer
+    if t is None:
+        return _NoopHandle()
+    return t.start_span(name, **attrs)
 
 
 def with_tracing(tracer: Tracer):

@@ -16,6 +16,7 @@ star).  A `flush_interval` of 0 keeps p99 latency at one loop tick.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from dataclasses import dataclass
 
 from ..tbls import api as tbls
@@ -31,11 +32,15 @@ class _Pending:
 
 
 class SigAgg:
-    def __init__(self, threshold: int, flush_interval: float = 0.0):
+    def __init__(self, threshold: int, flush_interval: float = 0.0,
+                 tracer=None):
         self._threshold = threshold
         self._flush_interval = flush_interval
         self._subs: list = []
         self._queue: list[_Pending] = []
+        # app.tracing.Tracer: each coalesced combine becomes a
+        # "tpu/threshold_combine" span (batch, T, MSM path, padded rows)
+        self._tracer = tracer
 
     def subscribe(self, fn) -> None:
         self._subs.append(fn)
@@ -69,8 +74,15 @@ class SigAgg:
             {p.share_idx: p.signature for p in item.parsigs}
             for item in batch
         ]
+        t = max(len(s) for s in sig_sets)
+        span = (self._tracer.start_span(
+            "tpu/threshold_combine", batch=len(batch), t=t,
+            path=tbls.combine_path(),
+            padded_rows=tbls.combine_padded_rows(len(batch), t))
+            if self._tracer is not None else contextlib.nullcontext())
         try:
-            combined = tbls.threshold_combine(sig_sets)  # ONE device launch
+            with span:
+                combined = tbls.threshold_combine(sig_sets)  # ONE launch
         except Exception as exc:
             for item in batch:
                 if not item.done.done():

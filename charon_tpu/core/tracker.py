@@ -5,11 +5,22 @@ output events, replay each duty's event trail after its deadline,
 determine the failing step and a human-readable reason (tracker.go:275-340),
 and account per-peer participation including unexpected-participation
 detection (tracker.go:508-567).
+
+With a registry wired (``Tracker(..., registry=...)``) the analysis also
+exports the reference's tracker metric families at /metrics
+(core/tracker/incldelay.go:39-117 + tracker.go participation gauges):
+
+- ``charon_tpu_tracker_inclusion_delay``          histogram, seconds from
+  slot start to the duty's broadcast hand-off (success duties)
+- ``charon_tpu_tracker_participation{peer=...}``  gauge, cumulative
+  participation ratio per peer share index
+- ``charon_tpu_tracker_failed_duties_total{step,reason}``  counter
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 from enum import IntEnum
@@ -86,7 +97,8 @@ class Tracker:
     `analyse(duty)` after the duty's deadline (Deadliner-driven in app
     wiring)."""
 
-    def __init__(self, num_peers: int, threshold: int):
+    def __init__(self, num_peers: int, threshold: int, registry=None,
+                 slot_start_fn=None):
         self._events: dict[Duty, set[Step]] = defaultdict(set)
         self._parsigs: dict[Duty, dict[PubKey, set[int]]] = defaultdict(
             lambda: defaultdict(set))
@@ -97,6 +109,11 @@ class Tracker:
         # cumulative per-peer participation counters (metrics feed)
         self.participation_counts: dict[int, int] = defaultdict(int)
         self.duty_total: int = 0
+        # metrics export (app.monitoring.Registry) + slot→unix-start map
+        # for inclusion-delay accounting (genesis + slot·duration)
+        self._registry = registry
+        self._slot_start_fn = slot_start_fn
+        self._bcast_time: dict[Duty, float] = {}
 
     def subscribe(self, fn) -> None:
         """fn(report: DutyReport) on each analysed duty."""
@@ -133,6 +150,10 @@ class Tracker:
         self._events[duty].add(Step.SIG_AGG)
         self._events[duty].add(Step.AGG_SIG_DB)
         self._events[duty].add(Step.BCAST)
+        # first aggregate of the duty = broadcast hand-off time (the
+        # inclusion-delay numerator; reference: incldelay.go:39-117 uses
+        # the block-import observation, here the bcast edge)
+        self._bcast_time.setdefault(duty, time.time())
 
     def _record_parsigs(self, duty: Duty, pset: ParSignedDataSet) -> None:
         for pubkey, psig in pset.items():
@@ -170,9 +191,31 @@ class Tracker:
                 reason=_REASONS.get(failed, "unknown"),
                 participation=participation)
         self.reports.append(report)
+        self._export_metrics(report, self._bcast_time.pop(duty, None))
         for fn in self._subs:
             await fn(report)
         return report
+
+    def _export_metrics(self, report: DutyReport,
+                        bcast_time: float | None) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        for idx in range(1, self._num_peers + 1):
+            reg.set_gauge(
+                "charon_tpu_tracker_participation",
+                self.participation_counts[idx] / max(1, self.duty_total),
+                labels={"peer": str(idx)})
+        reg.set_gauge("charon_tpu_tracker_duties_analysed_total",
+                      self.duty_total)
+        if not report.success:
+            reg.inc("charon_tpu_tracker_failed_duties_total",
+                    labels={"step": report.failed_step.name.lower(),
+                            "reason": report.reason})
+        elif bcast_time is not None and self._slot_start_fn is not None:
+            delay = bcast_time - self._slot_start_fn(report.duty.slot)
+            reg.observe("charon_tpu_tracker_inclusion_delay", delay,
+                        labels={"duty_type": report.duty.type.name.lower()})
 
     def unexpected_participants(self, duty: Duty) -> set[int]:
         """Peers whose partial sigs arrived for a duty we never scheduled

@@ -26,6 +26,7 @@ visible at /metrics.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from dataclasses import dataclass, field
 
 from ..tbls import api as tbls
@@ -38,10 +39,14 @@ class _Pending:
 
 
 class BatchVerifier:
-    def __init__(self, flush_interval: float = 0.0, on_launch=None):
+    def __init__(self, flush_interval: float = 0.0, on_launch=None,
+                 tracer=None):
         self._flush_interval = flush_interval
         self._queue: list[_Pending] = []
         self._on_launch = on_launch  # fn(self), called after every launch
+        # app.tracing.Tracer: each coalesced launch becomes a
+        # "tpu/batch_verify" span (batch size, pairing path, padded rows)
+        self._tracer = tracer
         # batching-efficacy counters (asserted in tests, exported to
         # /metrics by app wiring)
         self.launches = 0
@@ -80,8 +85,15 @@ class BatchVerifier:
         if not batch:
             return  # a sibling flusher already drained the queue
         flat = [e for item in batch for e in item.entries]
+        span = (self._tracer.start_span(
+            "tpu/batch_verify", batch=len(flat),
+            path=tbls.verify_path(len(flat)),
+            padded_rows=tbls.verify_padded_rows(len(flat)),
+            coalesced_calls=len(batch))
+            if self._tracer is not None else contextlib.nullcontext())
         try:
-            oks = tbls.batch_verify(flat)   # ONE device launch
+            with span:
+                oks = tbls.batch_verify(flat)   # ONE device launch
         except Exception as exc:
             for item in batch:
                 if not item.done.done():

@@ -341,6 +341,37 @@ def verify_path(n: int = 2048) -> str:
     return path_fn(n) if path_fn is not None else _current.name
 
 
+def combine_path() -> str:
+    """Which MSM implementation `threshold_combine` takes on the active
+    scheme/backend (``straus`` / ``dblsel`` / ``jnp`` / ``cpu`` /
+    ``insecure-test``) — span + /metrics attribution for the combine
+    launches, symmetric with :func:`verify_path`."""
+    if _scheme == "insecure-test":
+        return "insecure-test"
+    path_fn = getattr(_current, "combine_path", None)
+    return path_fn() if path_fn is not None else _current.name
+
+
+def verify_padded_rows(n: int) -> int:
+    """Device rows an n-entry `batch_verify` actually launches after the
+    backend's padding (power-of-two / tile-grid floors).  Backends
+    without padding report n — the padded-vs-real span attribute the TPU
+    boundary spans carry."""
+    if _scheme == "insecure-test":
+        return n
+    fn = getattr(_current, "verify_padded_rows", None)
+    return fn(n) if fn is not None else n
+
+
+def combine_padded_rows(v: int, t: int) -> int:
+    """Validator rows a [v × t-share] `threshold_combine` launches after
+    backend padding (see :func:`verify_padded_rows`)."""
+    if _scheme == "insecure-test":
+        return v
+    fn = getattr(_current, "combine_padded_rows", None)
+    return fn(v, t) if fn is not None else v
+
+
 # ---------------------------------------------------------------------------
 # Insecure test scheme — pipeline tests only.
 #

@@ -178,6 +178,14 @@ def _msm_kind() -> str:
     return kind
 
 
+def combine_path() -> str:
+    """Which combine implementation serves `threshold_combine` right now:
+    ``straus``/``dblsel`` when the fused bytes path is on (fallback
+    latch included), else the split-launch ``jnp`` path — surfaced by
+    core.sigagg's combine spans and /metrics."""
+    return _msm_kind() if _use_fused() else "jnp"
+
+
 #: Scalar-plane widths of the fused combine paths: 256-bit scalars recode
 #: to ⌈258/3⌉ + 1 carry = 87 balanced base-8 digits (straus) or 256 bit
 #: planes (dblsel).  Module-level, not inline literals, so the tier-1
@@ -453,6 +461,29 @@ class TPUBackend:
     def verify_path(self, n: int) -> str:
         return pairing_path(n)
 
+    def combine_path(self) -> str:
+        return combine_path()
+
+    def verify_padded_rows(self, n: int) -> int:
+        """Device rows an n-entry verify launches: the fused RLC path
+        has a 512-entry tile floor, the jnp path pads to a power of
+        two (the padded-vs-real span attribute)."""
+        if n == 0:
+            return 0
+        if _use_pairing_fused(n):
+            return max(_VERIFY_MIN_ROWS // 2, _pad_pow2(n))
+        return _pad_pow2(n)
+
+    def combine_padded_rows(self, v: int, t: int) -> int:
+        """Validator rows a combine launches: the fused bytes path pads
+        V to a 1024-row tile multiple, the split-launch path to a power
+        of two."""
+        if v == 0:
+            return 0
+        if _use_fused():
+            return max(1024, -(-v // 1024) * 1024)
+        return _pad_pow2(v)
+
     def batch_verify(self, entries) -> list[bool]:
         """entries: [(pk_point, msg_bytes, sig_point)] → [bool].
 
@@ -675,6 +706,9 @@ class TPUBackend:
     #: check — the most expensive slice of entry decompression — runs
     #: once per distinct key per process.
     _PK_CACHE: dict[bytes, tuple[np.ndarray, bool]] = {}
+    #: cumulative cache efficacy counters (served at /debug/memory)
+    pk_cache_hits = 0
+    pk_cache_misses = 0
 
     def _pk_planes_cached(self, pk_bytes_list) -> tuple[np.ndarray,
                                                         np.ndarray]:
@@ -690,17 +724,26 @@ class TPUBackend:
                 planes[k], ok[k] = hit
             else:
                 miss.setdefault(pk, []).append(k)
+        type(self).pk_cache_hits += m - sum(len(v) for v in miss.values())
         if miss:
+            # lazy import: app.tracing imports nothing from tbls, and
+            # importing at module scope would drag the app layer into
+            # every bench/ops process that only wants kernels
+            from ..app.tracing import device_span
+
+            type(self).pk_cache_misses += sum(len(v) for v in miss.values())
             keys = list(miss)
             mp = _pad_pow2(len(keys), floor=8)
-            raw = np.zeros((mp, 48), np.uint8)
-            raw[:, 0] = 0xC0
-            for j, pk in enumerate(keys):
-                raw[j] = np.frombuffer(pk, np.uint8)
-            x, sign, inf, bad = codec.g1_bytes_split(raw)
-            pts, dec = _pk_decompress_kernel(
-                jnp.asarray(x), jnp.asarray(sign), jnp.asarray(inf))
-            pts, dec = np.asarray(pts), np.asarray(dec) & ~bad
+            with device_span("tpu/pk_decompress_miss", misses=len(keys),
+                             batch=m, padded_rows=mp):
+                raw = np.zeros((mp, 48), np.uint8)
+                raw[:, 0] = 0xC0
+                for j, pk in enumerate(keys):
+                    raw[j] = np.frombuffer(pk, np.uint8)
+                x, sign, inf, bad = codec.g1_bytes_split(raw)
+                pts, dec = _pk_decompress_kernel(
+                    jnp.asarray(x), jnp.asarray(sign), jnp.asarray(inf))
+                pts, dec = np.asarray(pts), np.asarray(dec) & ~bad
             if len(self._PK_CACHE) > 65536:
                 self._PK_CACHE.clear()
             for j, pk in enumerate(keys):

@@ -129,12 +129,30 @@ def test_cli_create_cluster_and_run(tmp_path):
                 lambda: urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/readyz", timeout=5).read())
             assert body == b"ok"
-            metrics = await asyncio.to_thread(
-                lambda: urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/metrics", timeout=5
-                ).read().decode())
+            def _get_metrics():
+                resp = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5)
+                return resp.headers.get("Content-Type"), resp.read().decode()
+
+            ctype, metrics = await asyncio.to_thread(_get_metrics)
+            assert ctype == "text/plain; version=0.0.4"
             assert "app_peers" in metrics
             assert "core_bcast_delay_seconds" in metrics
+
+            # tracker depth: per-peer participation + inclusion delay
+            # reach /metrics on every node whose tracker analysed a duty
+            all_metrics = [metrics]
+            for a in apps[1:]:
+                _, m = await asyncio.to_thread(
+                    lambda p=a.monitoring.port: (
+                        None, urllib.request.urlopen(
+                            f"http://127.0.0.1:{p}/metrics", timeout=5
+                        ).read().decode()))
+                all_metrics.append(m)
+            assert any("charon_tpu_tracker_participation" in m
+                       for m in all_metrics)
+            assert any("charon_tpu_tracker_inclusion_delay_bucket" in m
+                       for m in all_metrics)
 
             # --- /debug/qbft sniffer ring has decided instances ---
             import json as _json

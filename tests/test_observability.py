@@ -1,0 +1,469 @@
+"""Observability-layer unit tests: fixed-bucket histograms, the tracer
+span ring, OTLP JSON round-trips, export sinks, the monitoring debug
+endpoints (content types, /debug/spans, /debug/memory, /debug/profile),
+and the tracker metric families."""
+
+import asyncio
+import io
+import json
+import re
+import tarfile
+import time
+
+import pytest
+
+from charon_tpu.app import otlp
+from charon_tpu.app.monitoring import (DEFAULT_BUCKETS, METRICS_CONTENT_TYPE,
+                                       MonitoringAPI, Registry)
+from charon_tpu.app.tracing import Span, Tracer
+from charon_tpu.core.sigagg import SigAgg
+from charon_tpu.core.tracker import Step, Tracker
+from charon_tpu.core.types import Duty, DutyType, ParSignedData, SignedRandao
+from charon_tpu.core.verify import BatchVerifier
+from charon_tpu.tbls import api as tbls
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format validity (the e2e acceptance check reuses this)
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+]+(-[0-9]+)?$")
+_COMMENT = re.compile(r"^# (TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                      r"(counter|gauge|histogram|summary|untyped)|HELP .*)$")
+
+
+def assert_prometheus_valid(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert _COMMENT.match(line), f"bad comment line: {line!r}"
+        else:
+            assert _SAMPLE.match(line), f"bad sample line: {line!r}"
+
+
+def test_histogram_fixed_buckets_render():
+    reg = Registry(const_labels={"cluster_name": "t"})
+    reg.set_buckets("app_test_seconds", (0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        reg.observe("app_test_seconds", v)
+    text = reg.render()
+    assert_prometheus_valid(text)
+    assert "# TYPE app_test_seconds histogram" in text
+    assert 'app_test_seconds_bucket{cluster_name="t",le="0.1"} 1' in text
+    assert 'app_test_seconds_bucket{cluster_name="t",le="1"} 2' in text
+    assert 'app_test_seconds_bucket{cluster_name="t",le="10"} 3' in text
+    assert 'app_test_seconds_bucket{cluster_name="t",le="+Inf"} 4' in text
+    assert 'app_test_seconds_count{cluster_name="t"} 4' in text
+    # memory is O(buckets), not O(samples): the series object stores
+    # counts, never the sample list
+    [h] = reg._hist.values()
+    assert not hasattr(h, "__dict__") and len(h.counts) == 3
+
+
+def test_histogram_default_buckets_and_per_metric_config():
+    reg = Registry()
+    reg.observe("app_default_seconds", 0.003)
+    reg.set_buckets("app_custom", (1, 2))
+    reg.observe("app_custom", 1.5)
+    text = reg.render()
+    assert f'le="{DEFAULT_BUCKETS[0]}"' in text
+    assert 'app_custom_bucket{le="1"} 0' in text
+    assert 'app_custom_bucket{le="2"} 1' in text
+    assert_prometheus_valid(text)
+
+
+def test_histogram_label_values_escaped():
+    reg = Registry()
+    reg.inc("app_err_total", labels={"reason": 'say "hi"\nnewline'})
+    text = reg.render()
+    assert '\\"hi\\"' in text and "\\n" in text
+    assert_prometheus_valid(text)
+
+
+# ---------------------------------------------------------------------------
+# Tracer span ring
+# ---------------------------------------------------------------------------
+
+def test_failing_sink_never_breaks_the_spanned_operation():
+    """A broken exporter (missing trace dir, full disk) is a telemetry
+    loss, never a duty failure: the span-wrapped operation completes and
+    the error is counted once."""
+    tr = Tracer()
+    tr.add_sink(otlp.FileSink("/nonexistent-dir/spans.jsonl",
+                              batch_size=1))
+
+    def bad_sink(span):
+        raise OSError("disk full")
+
+    tr.add_sink(bad_sink)
+    ran = []
+    with tr.start_span("tpu/batch_verify"):
+        ran.append(True)  # the operation inside the span
+    assert ran and tr.sink_errors == 2
+    with tr.start_span("next"):
+        pass
+    assert tr.sink_errors == 4  # counted, not raised, on every span
+
+
+def test_tracer_ring_buffer_wrap_counts_drops():
+    reg = Registry()
+    tr = Tracer(reg, max_spans=4)
+    for i in range(10):
+        with tr.start_span(f"s{i}"):
+            pass
+    assert len(tr.spans) == 4
+    # the ring keeps the most RECENT spans (old behaviour kept the oldest
+    # and silently dropped everything new)
+    assert [s.name for s in tr.spans] == ["s6", "s7", "s8", "s9"]
+    assert tr.dropped == 6
+    assert ("charon_tpu_tracer_dropped_spans_total", ()) in reg._counters
+    assert reg._counters[("charon_tpu_tracer_dropped_spans_total", ())] == 6
+
+
+# ---------------------------------------------------------------------------
+# OTLP JSON round-trip + sinks
+# ---------------------------------------------------------------------------
+
+def _finished_span(tr: Tracer, name="op", **attrs) -> Span:
+    with tr.start_span(name, **attrs) as s:
+        pass
+    return s
+
+
+def test_otlp_round_trip():
+    tr = Tracer()
+    with tr.start_span("parent", duty="5/attester") as parent:
+        child = _finished_span(tr, "child", batch=7, ratio=0.5, ok=True)
+    doc = otlp.export_request([parent, child], {"service.name": "charon"})
+    back = otlp.parse_export(json.loads(json.dumps(doc)))
+    assert [s.name for s in back] == ["parent", "child"]
+    p, c = back
+    assert p.trace_id == parent.trace_id == c.trace_id
+    assert c.parent_id == p.span_id
+    assert c.attrs == {"batch": 7, "ratio": 0.5, "ok": True}
+    assert p.attrs == {"duty": "5/attester"}
+    assert abs(p.start - parent.start) < 1e-6
+    assert p.end is not None
+
+
+def test_file_sink_jsonl(tmp_path):
+    path = str(tmp_path / "spans.otlp.jsonl")
+    tr = Tracer()
+    sink = otlp.FileSink(path, resource_attrs={"peer": "node0"},
+                         batch_size=2)
+    tr.add_sink(sink)
+    names = [f"edge{i}" for i in range(5)]
+    for n in names:
+        _finished_span(tr, n)
+    sink.close()
+    with open(path) as f:
+        text = f.read()
+    assert len(text.strip().splitlines()) == 3  # 2 + 2 + flush(1)
+    back = otlp.parse_export_lines(text)
+    assert [s.name for s in back] == names
+    assert sink.exported == 5
+
+
+def test_async_http_sink_posts_and_bounds_queue():
+    async def main():
+        received = []
+
+        async def handle(reader, writer):
+            await reader.readline()
+            clen = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                if line.lower().startswith(b"content-length"):
+                    clen = int(line.split(b":")[1])
+            received.append(json.loads(await reader.readexactly(clen)))
+            writer.write(b"HTTP/1.0 200 OK\r\nContent-Length: 0\r\n\r\n")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            tr = Tracer()
+            sink = otlp.AsyncHTTPSink(
+                f"http://127.0.0.1:{port}/v1/traces",
+                resource_attrs={"peer": "n0"}, flush_interval=0.05)
+            tr.add_sink(sink)
+            for i in range(3):
+                _finished_span(tr, f"s{i}")
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if sink.exported == 3:
+                    break
+            assert sink.exported == 3 and sink.dropped == 0
+            spans = [s for doc in received for s in otlp.parse_export(doc)]
+            assert {s.name for s in spans} == {"s0", "s1", "s2"}
+
+            # bounded queue: with the flusher effectively stalled, spans
+            # beyond max_queue are counted dropped, not enqueued
+            reg = Registry()
+            slow = otlp.AsyncHTTPSink(
+                f"http://127.0.0.1:{port}/v1/traces", registry=reg,
+                max_queue=2, flush_interval=60.0)
+            tr2 = Tracer()
+            tr2.add_sink(slow)
+            for i in range(5):
+                _finished_span(tr2, f"d{i}")
+            assert slow.dropped == 3 and len(slow._queue) == 2
+            assert reg._counters[("app_otlp_dropped_spans_total", ())] == 3
+            await slow.aclose()   # final drain still exports the queued 2
+            assert slow.exported == 2
+            await sink.aclose()
+        finally:
+            server.close()
+    asyncio.run(main())
+
+
+def test_sinks_from_env(tmp_path):
+    path = str(tmp_path / "{node}.jsonl")
+    env = {"CHARON_TPU_TRACE_FILE": path,
+           "CHARON_TPU_TRACE_ENDPOINT": "http://127.0.0.1:9/v1/traces",
+           "CHARON_TPU_TRACE_QUEUE": "7"}
+    sinks = otlp.sinks_from_env(node_name="node3", environ=env)
+    assert len(sinks) == 2
+    assert sinks[0].path.endswith("node3.jsonl")
+    assert sinks[1]._max_queue == 7
+    assert otlp.sinks_from_env(environ={}) == []
+    with pytest.raises(ValueError):
+        otlp.AsyncHTTPSink("grpc://nope")
+
+
+# ---------------------------------------------------------------------------
+# Monitoring endpoints: content types + debug endpoints
+# ---------------------------------------------------------------------------
+
+async def _fetch(port: int, target: str):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    w.write(f"GET {target} HTTP/1.0\r\n\r\n".encode())
+    raw = await r.read()
+    w.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return lines[0].split(" ", 1)[1], headers, body
+
+
+def test_monitoring_content_types_and_debug_endpoints():
+    async def main():
+        reg = Registry(const_labels={"peer": "node0"})
+        reg.inc("app_requests_total")
+        reg.observe("app_latency_seconds", 0.2)
+        tr = Tracer(reg)
+        with tr.start_span("core/fetcher_fetch", duty="9/attester"):
+            pass
+        api = MonitoringAPI(reg, readyz=lambda: (True, "ok"),
+                            identity="enr:-node0",
+                            qbft_debug=lambda: b'{"instances": []}',
+                            tracer=tr,
+                            memory_extra=lambda: {"extra_stat": 42})
+        await api.start()
+        try:
+            status, headers, body = await _fetch(api.port, "/metrics")
+            assert status == "200 OK"
+            assert headers["content-type"] == METRICS_CONTENT_TYPE
+            assert_prometheus_valid(body.decode())
+
+            status, headers, _ = await _fetch(api.port, "/livez")
+            assert headers["content-type"] == "text/plain"
+
+            status, headers, body = await _fetch(api.port, "/debug/qbft")
+            assert headers["content-type"] == "application/json"
+            json.loads(body)
+
+            # /debug/spans: the span ring round-trips through the OTLP
+            # JSON parser with ids and attrs intact
+            status, headers, body = await _fetch(api.port, "/debug/spans")
+            assert status == "200 OK"
+            assert headers["content-type"] == "application/json"
+            doc = json.loads(body)
+            spans = otlp.parse_export(doc)
+            assert [s.name for s in spans] == ["core/fetcher_fetch"]
+            assert spans[0].attrs["duty"] == "9/attester"
+            assert spans[0].trace_id == next(iter(tr.spans)).trace_id
+            res_attrs = {a["key"]: a["value"] for a in
+                         doc["resourceSpans"][0]["resource"]["attributes"]}
+            assert res_attrs["peer"] == {"stringValue": "node0"}
+
+            status, headers, body = await _fetch(api.port, "/debug/memory")
+            assert status == "200 OK"
+            assert headers["content-type"] == "application/json"
+            mem = json.loads(body)
+            assert mem["live_arrays"] >= 0
+            assert mem["tracer"]["spans_buffered"] == 1
+            assert mem["extra_stat"] == 42
+
+            status, headers, _ = await _fetch(api.port, "/nope")
+            assert status.startswith("404")
+        finally:
+            await api.stop()
+    asyncio.run(main())
+
+
+def test_debug_profile_returns_nonempty_capture():
+    """/debug/profile?seconds=N streams back a non-empty jax.profiler
+    capture (gzipped tar) on CPU — the acceptance-criteria device-trace
+    path, TPU-identical code."""
+    async def main():
+        api = MonitoringAPI(Registry(), readyz=lambda: (True, "ok"))
+        await api.start()
+        try:
+            status, headers, body = await _fetch(
+                api.port, "/debug/profile?seconds=0.2")
+            assert status == "200 OK", body
+            assert headers["content-type"] == "application/octet-stream"
+            assert len(body) > 0
+            with tarfile.open(fileobj=io.BytesIO(body), mode="r:gz") as tar:
+                names = tar.getnames()
+            # xplane protobuf capture files inside the trace directory
+            assert any("xplane" in n or "profile" in n for n in names)
+            assert len(names) > 1
+
+            status, _, body = await _fetch(
+                api.port, "/debug/profile?seconds=nope")
+            assert status.startswith("400")
+        finally:
+            await api.stop()
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Tracker metric families
+# ---------------------------------------------------------------------------
+
+def _psd(idx):
+    return ParSignedData(data=SignedRandao(epoch=0, signature=bytes(96)),
+                         share_idx=idx)
+
+
+def test_tracker_exports_participation_and_inclusion_delay():
+    async def main():
+        reg = Registry()
+        t0 = time.time()
+        tr = Tracker(num_peers=3, threshold=2, registry=reg,
+                     slot_start_fn=lambda slot: t0)
+        duty = Duty(5, DutyType.ATTESTER)
+        await tr.on_duty_scheduled(duty, {})
+        await tr.on_fetched(duty, {})
+        await tr.on_consensus(duty, {})
+        await tr.on_parsig_internal(duty, {"pk": _psd(1)})
+        await tr.on_parsig_external(duty, {"pk": _psd(2)})
+        await tr.on_threshold(duty, "pk", [])
+        await tr.on_aggregated(duty, "pk", None)
+        report = await tr.analyse(duty)
+        assert report.success
+
+        text = reg.render()
+        assert_prometheus_valid(text)
+        assert 'charon_tpu_tracker_participation{peer="1"} 1.0' in text
+        assert 'charon_tpu_tracker_participation{peer="3"} 0.0' in text
+        assert ("charon_tpu_tracker_inclusion_delay_bucket"
+                '{duty_type="attester",le="+Inf"} 1') in text
+        assert ('charon_tpu_tracker_inclusion_delay_count'
+                '{duty_type="attester"} 1') in text
+        # the observed delay is (bcast time − slot start): small here
+        key = ("charon_tpu_tracker_inclusion_delay",
+               (("duty_type", "attester"),))
+        assert 0 <= reg._hist[key].sum < 5.0
+
+        # failed duty: failed_duties_total{step,reason}
+        duty2 = Duty(6, DutyType.ATTESTER)
+        await tr.on_duty_scheduled(duty2, {})
+        await tr.on_fetched(duty2, {})
+        report2 = await tr.analyse(duty2)
+        assert not report2.success and report2.failed_step == Step.CONSENSUS
+        text = reg.render()
+        assert 'charon_tpu_tracker_failed_duties_total{reason=' in text
+        assert 'step="consensus"' in text
+        assert 'charon_tpu_tracker_participation{peer="1"} 0.5' in text
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# TPU-boundary spans (BatchVerifier / SigAgg launches)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def test_verify_and_combine_launches_are_spanned(insecure_scheme):
+    async def main():
+        tr = Tracer()
+        verifier = BatchVerifier(tracer=tr)
+        sk = tbls.generate_privkey()
+        pk = tbls.privkey_to_pubkey(sk)
+        sig = tbls.sign(sk, b"msg")
+        oks = await verifier.verify_many([(pk, b"msg", sig)] * 3)
+        assert all(oks)
+        [vspan] = [s for s in tr.spans if s.name == "tpu/batch_verify"]
+        assert vspan.attrs["batch"] == 3
+        assert vspan.attrs["path"] == "insecure-test"
+        assert vspan.attrs["padded_rows"] == 3  # no padding off-device
+        assert vspan.end is not None
+
+        sigagg = SigAgg(2, tracer=tr)
+        await sigagg.aggregate(Duty(7, DutyType.RANDAO), "pk",
+                               [_psd(1), _psd(2)])
+        [cspan] = [s for s in tr.spans if s.name == "tpu/threshold_combine"]
+        assert cspan.attrs["batch"] == 1 and cspan.attrs["t"] == 2
+        assert cspan.attrs["path"] == "insecure-test"
+    asyncio.run(main())
+
+
+def test_pk_decompress_cache_miss_is_spanned():
+    """The decompressed-pubkey cache miss launch spans into the
+    process-global tracer: one span per miss batch with distinct-key
+    count, request batch and padded rows; hits are span-free."""
+    pytest.importorskip("jax")
+    from charon_tpu.app import tracing
+    from charon_tpu.tbls import backend_tpu
+    from charon_tpu.tbls.ref import curve as refcurve
+
+    tr = Tracer()
+    tracing.set_global_tracer(tr)
+    try:
+        be = backend_tpu.TPUBackend()
+        be._PK_CACHE.clear()
+        pk = refcurve.g1_to_bytes(refcurve.G1_GEN)
+        hits0 = backend_tpu.TPUBackend.pk_cache_hits
+        planes, ok = be._pk_planes_cached([pk, pk])
+        assert list(ok) == [True, True]
+        [span] = [s for s in tr.spans
+                  if s.name == "tpu/pk_decompress_miss"]
+        assert span.attrs == {"misses": 1, "batch": 2, "padded_rows": 8}
+        assert span.end is not None
+        # second call: pure cache hit, no new span
+        be._pk_planes_cached([pk])
+        assert backend_tpu.TPUBackend.pk_cache_hits >= hits0 + 1
+        assert len([s for s in tr.spans
+                    if s.name == "tpu/pk_decompress_miss"]) == 1
+    finally:
+        tracing.set_global_tracer(None)
+
+
+def test_tpu_backend_padded_rows_and_paths():
+    """The TPU backend reports its real padding arithmetic through the
+    tbls helpers the spans use (no device launch: arithmetic only)."""
+    pytest.importorskip("jax")
+    from charon_tpu.tbls import backend_tpu
+
+    be = backend_tpu.TPUBackend()
+    assert be.verify_padded_rows(0) == 0
+    # jnp path (CPU backend → fused off): power-of-two padding
+    assert be.verify_padded_rows(3) == 4
+    assert be.combine_padded_rows(0, 2) == 0
+    assert be.combine_padded_rows(3, 2) in (4, 1024)
+    assert backend_tpu.combine_path() in ("straus", "dblsel", "jnp")
+    assert backend_tpu.pairing_path(2048) in ("pallas-rlc", "jnp")

@@ -1,0 +1,221 @@
+"""4-node observability e2e — the acceptance run for the duty-path
+observability layer.
+
+A full in-memory simnet cluster (4 nodes, t=3) with the complete
+observability stack wired per node: monitoring Registry + MonitoringAPI
+over real HTTP, duty Tracer with an OTLP/JSON file sink per node, and a
+Tracker + Deadliner GC exporting per-peer participation and inclusion
+delay.  Asserts:
+
+- every node exports OTLP JSON, and one duty's spans join into a single
+  cross-node trace (identical 128-bit trace IDs in the export files);
+- /metrics serves per-peer participation and inclusion-delay histograms
+  in valid Prometheus text format (0.0.4 content type);
+- /debug/profile returns a non-empty jax profiler capture on CPU;
+- /debug/spans round-trips through the OTLP JSON parser.
+
+Uses the insecure-test tbls scheme (identical threshold semantics; real
+BLS device paths are covered by tests/test_tbls_backend.py) — the same
+trade the reference makes in app/simnet_test.go.
+"""
+
+import asyncio
+import io
+import json
+import re
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from charon_tpu.app import otlp
+from charon_tpu.app.monitoring import (METRICS_CONTENT_TYPE, MonitoringAPI,
+                                       Registry)
+from charon_tpu.app.node import Node, NodeConfig
+from charon_tpu.app.tracing import Tracer, duty_trace_id
+from charon_tpu.core.leadercast import LeaderCast, MemTransportNetwork
+from charon_tpu.core.parsigex import MemParSigExNetwork
+from charon_tpu.tbls import api as tbls
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.cluster import new_cluster_for_test
+from charon_tpu.testutil.validatormock import ValidatorMock
+from tests.test_observability import assert_prometheus_valid
+
+N_NODES = 4
+THRESHOLD = 3
+N_VALS = 2
+SLOT_DUR = 0.25
+SPE = 4
+FORK = bytes.fromhex("00000000")
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def build_observable_cluster(tmp_path):
+    cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
+    bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)
+    for v in cluster.validators:
+        bmock.add_validator(v.group_pubkey)
+
+    pubshares_by_peer = {
+        idx: cluster.pubshare_map(idx) for idx in range(1, N_NODES + 1)}
+    psx_net = MemParSigExNetwork()
+    lc_net = MemTransportNetwork()
+
+    nodes, sinks = [], []
+    for idx in range(1, N_NODES + 1):
+        registry = Registry(const_labels={"node": f"node{idx - 1}"})
+        registry.set_buckets(
+            "charon_tpu_tracker_inclusion_delay",
+            (0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0))
+        tracer = Tracer(registry)
+        sink = otlp.FileSink(str(tmp_path / f"node{idx - 1}.otlp.jsonl"),
+                             resource_attrs={"peer": f"node{idx - 1}"})
+        tracer.add_sink(sink)
+        sinks.append(sink)
+        cfg = NodeConfig(share_idx=idx, threshold=THRESHOLD,
+                         pubshares_by_peer=pubshares_by_peer,
+                         fork_version=FORK)
+        node = Node(cfg, bmock,
+                    consensus=LeaderCast(lc_net, idx - 1, N_NODES),
+                    parsigex=psx_net.join(),
+                    slots_per_epoch=SPE, genesis_time=bmock.genesis,
+                    slot_duration=SLOT_DUR,
+                    registry=registry, tracer=tracer)
+        vmock = ValidatorMock(node.vapi, cluster.share_privkey_map(idx),
+                              FORK, slots_per_epoch=SPE, eth2cl=bmock)
+        node.scheduler.subscribe_slots(vmock.on_slot)
+        nodes.append(node)
+    return cluster, bmock, nodes, sinks
+
+
+def _http_get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def test_observability_e2e_4_nodes(tmp_path):
+    cluster, bmock, nodes, sinks = build_observable_cluster(tmp_path)
+
+    async def main():
+        apis = []
+        for node in nodes:
+            api = MonitoringAPI(
+                node.registry, readyz=lambda: (True, "ok"),
+                tracer=node.tracer)
+            await api.start()
+            apis.append(api)
+        for n in nodes:
+            n.start()
+        try:
+            # run until every node's tracker analysed a successful duty
+            # (deadline = slot + 5 slots, so ~2.5 s wall-clock minimum)
+            deadline = time.time() + 8 * SPE * SLOT_DUR + 10.0
+            while time.time() < deadline:
+                await asyncio.sleep(0.1)
+                if bmock.attestations and all(
+                        any(r.success for r in n.tracker.reports)
+                        for n in nodes):
+                    break
+            assert bmock.attestations, "no attestations broadcast"
+            assert all(any(r.success for r in n.tracker.reports)
+                       for n in nodes), "a node never analysed a success"
+
+            # --- /metrics: per-peer participation + inclusion delay in
+            #     valid Prometheus text format, correct content type ---
+            for api in apis:
+                status, headers, body = await asyncio.to_thread(
+                    _http_get, api.port, "/metrics")
+                assert status == 200
+                assert headers["Content-Type"] == METRICS_CONTENT_TYPE
+                text = body.decode()
+                assert_prometheus_valid(text)
+                # subject-peer label AND the node's own identity label
+                # coexist (the const "node" key survives the merge)
+                for peer in range(1, N_NODES + 1):
+                    assert re.search(
+                        r'charon_tpu_tracker_participation'
+                        rf'\{{node="node\d+",peer="{peer}"\}} ', text)
+                assert "charon_tpu_tracker_inclusion_delay_bucket" in text
+                assert 'le="+Inf"' in text
+                assert "charon_tpu_tracker_inclusion_delay_count" in text
+                # TPU-boundary launches surfaced as spans feed the
+                # span-duration histogram too
+                assert "app_span_duration_seconds" in text
+
+            # --- inclusion delay measured inside the duty window ---
+            n0 = nodes[0]
+            key = next(k for k in n0.registry._hist
+                       if k[0] == "charon_tpu_tracker_inclusion_delay")
+            h = n0.registry._hist[key]
+            assert h.count >= 1
+            assert 0 < h.sum / h.count < 5 * SLOT_DUR * 6
+
+            # --- cross-node trace join: one duty, one trace ID, spans
+            #     from ALL nodes in the OTLP exports ---
+            ok_duty = next(r.duty for r in n0.tracker.reports if r.success)
+            tid = duty_trace_id(ok_duty)
+            in_memory = sum(1 for n in nodes if n.tracer.trace(tid))
+            assert in_memory >= 2, "duty trace did not join across tracers"
+            for sink in sinks:
+                sink.close()
+            exported_tids = []
+            for idx in range(N_NODES):
+                with open(tmp_path / f"node{idx}.otlp.jsonl") as f:
+                    spans = otlp.parse_export_lines(f.read())
+                assert spans, f"node{idx} exported no OTLP spans"
+                tids = {s.trace_id for s in spans}
+                assert tid in tids, f"node{idx} export lacks the duty trace"
+                exported_tids.append(tid in tids)
+                # deterministic IDs: every span of the duty carries the
+                # identical 128-bit id (32 hex chars)
+                assert all(len(s.trace_id) == 32 for s in spans)
+            assert all(exported_tids), "OTLP trace ids did not join"
+
+            # --- TPU-boundary spans rode the same export (batch verify
+            #     + threshold combine launch spans) ---
+            all_spans = []
+            for idx in range(N_NODES):
+                with open(tmp_path / f"node{idx}.otlp.jsonl") as f:
+                    all_spans.extend(otlp.parse_export_lines(f.read()))
+            combine = [s for s in all_spans
+                       if s.name == "tpu/threshold_combine"]
+            assert combine, "no threshold_combine spans exported"
+            assert all(s.attrs["path"] == "insecure-test" for s in combine)
+            assert any(s.attrs["batch"] >= 1 for s in combine)
+
+            # --- /debug/spans round-trips through the OTLP parser ---
+            status, headers, body = await asyncio.to_thread(
+                _http_get, apis[0].port, "/debug/spans")
+            assert headers["Content-Type"] == "application/json"
+            dbg = otlp.parse_export(json.loads(body))
+            assert any(s.trace_id == tid for s in dbg)
+
+            # --- /debug/profile: non-empty jax profiler capture (CPU) ---
+            status, headers, body = await asyncio.to_thread(
+                _http_get, apis[0].port, "/debug/profile?seconds=0.2")
+            assert status == 200
+            assert headers["Content-Type"] == "application/octet-stream"
+            with tarfile.open(fileobj=io.BytesIO(body), mode="r:gz") as tar:
+                assert len(tar.getnames()) > 1
+
+            # --- /debug/memory reports tracer + live-array stats ---
+            status, headers, body = await asyncio.to_thread(
+                _http_get, apis[0].port, "/debug/memory")
+            mem = json.loads(body)
+            assert mem["tracer"]["spans_buffered"] > 0
+        finally:
+            for n in nodes:
+                n.stop()
+            for api in apis:
+                await api.stop()
+            await asyncio.sleep(0)
+
+    asyncio.run(main())

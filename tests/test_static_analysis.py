@@ -63,6 +63,63 @@ def test_arithmetic_audit_clean_for_every_registered_shape():
     assert (10_000, 7) in report.shapes_checked
     for k in report.kernels:
         assert k.s_rows_checked, f"{k.name}: no shapes checked"
+    # the metric-name lint rides every audit (and its result reaches the
+    # JSON report + summary)
+    assert report.metrics_lint is not None and report.metrics_lint.ok
+    assert report.to_dict()["metrics_lint"]["ok"]
+    assert "metric-name lint" in report.summary()
+
+
+def test_metric_name_lint_clean_at_head():
+    """Every registry call site in the package uses a snake_case,
+    subsystem-prefixed literal metric name with a single type."""
+    from charon_tpu.analysis.metrics_lint import lint_package
+
+    report = lint_package()
+    assert report.ok, "\n".join(report.violations)
+    names = report.names()
+    # the families this round added are registered at real call sites
+    assert "charon_tpu_tracker_participation" in names
+    assert "charon_tpu_tracker_inclusion_delay" in names
+    assert "charon_tpu_tracker_failed_duties_total" in names
+    assert "charon_tpu_tracer_dropped_spans_total" in names
+    assert names["charon_tpu_tracker_inclusion_delay"] == {"histogram"}
+
+
+def test_metric_name_lint_detects_violations():
+    """Golden-bad sources: non-snake-case, missing prefix, cross-type
+    collision, histogram stem collision, non-literal name."""
+    from charon_tpu.analysis.metrics_lint import lint_sources
+
+    bad = """
+reg.inc("core_CamelCase_total")
+reg.set_gauge("unprefixed_metric", 1)
+reg.observe("core_dual_use", 0.5)
+reg.inc("core_dual_use")
+reg.observe("app_latency_seconds", 0.1)
+reg.inc("app_latency_seconds_count")
+reg.inc(computed_name)
+"""
+    report = lint_sources({"charon_tpu/fake.py": bad})
+    text = "\n".join(report.violations)
+    assert "not snake_case" in text
+    assert "lacks a subsystem prefix" in text
+    assert "more than one type" in text
+    assert "collides with histogram" in text
+    assert "non-literal metric name" in text
+    assert not report.ok
+
+
+def test_metric_name_lint_cli_flag():
+    """`--no-metrics-lint` is accepted and the default full-audit CLI
+    path includes the lint (wired into __main__)."""
+    from charon_tpu.analysis.__main__ import main as analysis_main
+
+    # trace=none + no-shard keeps this sub-second; the lint runs and the
+    # audit stays green
+    assert analysis_main(["--trace", "none", "--no-shard"]) == 0
+    assert analysis_main(["--trace", "none", "--no-shard",
+                          "--no-metrics-lint"]) == 0
 
 
 def test_shard_carry_discipline_clean_at_head():
