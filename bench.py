@@ -73,6 +73,14 @@ def _preflight_audit(v: int, t: int) -> None:
         violations += pairing_report.violations
         summaries.append(pairing_report.summary())
         pairing_note = "pairing family traced at registered verify batches"
+    # same gate for the hash-to-G2 family: trace it whenever the device
+    # h2c path would serve this bench's cold-cache configs
+    h2c_note = "h2c path inactive (arith-only)"
+    if backend_tpu._use_h2c():
+        h2c_report = run_audit(trace="h2c", shard=False)
+        violations += h2c_report.violations
+        summaries.append(h2c_report.summary())
+        h2c_note = "h2c family traced at registered verify batches"
     if violations:
         for s in summaries:
             print(s, file=sys.stderr)
@@ -83,7 +91,7 @@ def _preflight_audit(v: int, t: int) -> None:
         sys.exit(2)
     print(f"preflight: kernel contract audit PASS "
           f"({len(report.kernels)} kernels at V={v} T={t}; "
-          f"{pairing_note})",
+          f"{pairing_note}; {h2c_note})",
           file=sys.stderr)
 
 
@@ -242,6 +250,11 @@ def main() -> None:
         configs = _run_baseline_configs(
             api, rng, pool_bytes, oracle_combine_row,
             verify_entries_for, REPS)
+        # cold-cache variants of configs 4 and 5: ALL-DISTINCT messages,
+        # hashed-message cache cleared before every rep — the workload
+        # the device hash-to-G2 path (ops/pallas_h2c, CHARON_TPU_H2C)
+        # takes off the host
+        configs += _run_cold_cache_configs(api, rng, REPS)
 
     result = {
         "metric": "sigagg_latency_p99_ms",
@@ -262,14 +275,18 @@ def main() -> None:
         "verify_baseline_r04_sigs_per_s": 1976,
         "verify_vs_r04": round(verify_sigs_per_s / 1976, 2),
         "verify_path": backend_tpu.pairing_path(VV),
+        "h2c_path": backend_tpu.h2c_path(),
         "configs": configs,
         "oracle_checked": True,
         "platform": jax.devices()[0].platform,
     }
+    for c in configs:
+        if c["config"] == "selection-proofs-2k-coldcache":
+            result["h2c_msgs_per_s"] = c["h2c_msgs_per_s"]
     out = json.dumps(result)
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r06.json")
+                            "BENCH_r07.json")
         with open(path, "w") as fh:
             fh.write(out + "\n")
     except OSError:
@@ -355,6 +372,128 @@ def _run_baseline_configs(api, rng, pool_bytes,
                    verify_fn=_dkg_share_verify_workload(rng)),
     ]
     return configs
+
+
+def _sign_distinct_msgs(msgs, sks):
+    """One valid (pk, msg, sig) wire entry per message with all-DISTINCT
+    messages.  Honesty anchor: the H(m) points the signatures are built
+    from come from the pure-Python ORACLE hash — a broken device
+    hash-to-G2 path cannot self-consistently verify; it must reproduce
+    the oracle's points bit-exactly or the batch rejects."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import codec
+    from charon_tpu.ops import curve as jcurve
+    from charon_tpu.ops.curve import F2_OPS
+    from charon_tpu.tbls.ref import bls, curve as refcurve
+    from charon_tpu.tbls.ref.hash_to_curve import hash_to_g2
+
+    n = len(msgs)
+    pks = [refcurve.g1_to_bytes(bls.sk_to_pk(sk)) for sk in sks]
+    hms = jcurve.g2_pack([hash_to_g2(m) for m in msgs])   # host oracle
+    bits = jnp.asarray(jcurve.scalars_to_bits(
+        [sks[k % len(sks)] for k in range(n)]))
+
+    @jax.jit
+    def _gen(hm_pts, b):
+        return codec.g2_normalize(jcurve.scalar_mul(F2_OPS, hm_pts, b))
+
+    sig_bytes = codec.g2_compress_np(
+        *map(np.asarray, _gen(jnp.asarray(hms), bits)))
+    return [(pks[k % len(pks)], msgs[k], sig_bytes[k].tobytes())
+            for k in range(n)]
+
+
+def _run_cold_cache_configs(api, rng, reps: int, n4: int = 2048,
+                            n5: int = 1000) -> list:
+    """Cold-cache measurement of the two per-validator-distinct-message
+    BASELINE workloads: config 4 (selection-proof batch, 2k distinct
+    signing roots) and config 5 (DKG share proofs across 1k distinct
+    ceremony transcripts, dkg/keygen.verify_share_proofs_multi).  The
+    hashed-message cache is cleared before EVERY rep, so each rep pays
+    the full hash-to-G2 cost for every distinct message — on the device
+    path (CHARON_TPU_H2C) or, for the A/B row, the host pure-Python
+    pipeline (forced CHARON_TPU_H2C=0)."""
+    import time
+
+    from charon_tpu.dkg import keygen
+    from charon_tpu.tbls import backend_tpu
+
+    def _timed_reps(verify_fn, force_host: bool):
+        prev = os.environ.get("CHARON_TPU_H2C")
+        if force_host:
+            os.environ["CHARON_TPU_H2C"] = "0"
+        try:
+            backend_tpu.TPUBackend._HM_CACHE.clear()
+            assert all(verify_fn())                     # compile + warmup
+            times = []
+            for _ in range(reps):
+                backend_tpu.TPUBackend._HM_CACHE.clear()
+                t0 = time.perf_counter()
+                ok = verify_fn()
+                times.append(time.perf_counter() - t0)
+                assert all(ok)
+            return times
+        finally:
+            if prev is None:
+                os.environ.pop("CHARON_TPU_H2C", None)
+            else:
+                os.environ["CHARON_TPU_H2C"] = prev
+
+    def _entry(name, t_count, n_msgs, verify_fn, corrupt_fn):
+        # honesty: a corrupted row inside the otherwise-valid batch must
+        # be isolated through the cold-cache path too
+        backend_tpu.TPUBackend._HM_CACHE.clear()
+        bad = corrupt_fn()
+        assert not bad[len(bad) // 2] and sum(bad) == len(bad) - 1, \
+            f"{name}: cold-cache verify failed to isolate corrupted row"
+        times = _timed_reps(verify_fn, force_host=False)
+        host_times = _timed_reps(verify_fn, force_host=True)
+        med = sorted(times)[len(times) // 2]
+        host_med = sorted(host_times)[len(host_times) // 2]
+        return {
+            "config": name, "V": 0, "T": t_count, "reps": reps,
+            "cold_cache": True, "distinct_msgs": n_msgs,
+            "verify_entries": n_msgs,
+            "rep_times_ms": [round(t * 1e3, 3) for t in times],
+            "host_rep_times_ms": [round(t * 1e3, 3) for t in host_times],
+            "h2c_msgs_per_s": round(n_msgs / med, 1),
+            "h2c_host_msgs_per_s": round(n_msgs / host_med, 1),
+            "h2c_path": backend_tpu.h2c_path(),
+        }
+
+    out = []
+
+    # config 4 cold: 2k selection proofs, one distinct signing root each
+    sks4 = [int(s) for s in rng.integers(1, 1 << 62, 8)]
+    entries4 = _sign_distinct_msgs(
+        [b"bench-selection-proof-%d" % k for k in range(n4)], sks4)
+    bad4 = list(entries4)
+    k4 = len(bad4) // 2
+    bad4[k4] = (bad4[k4][0], b"bench-corrupted-selection", bad4[k4][2])
+    out.append(_entry(
+        "selection-proofs-2k-coldcache", 7, n4,
+        lambda: api.batch_verify(entries4),
+        lambda: api.batch_verify(bad4)))
+
+    # config 5 cold: 1k DKG share proofs, one distinct ceremony
+    # transcript per validator (verify_share_proofs_multi)
+    transcripts = [b"bench-dkg-transcript-%d" % v for v in range(n5)]
+    msgs5 = [keygen.share_proof_msg(t) for t in transcripts]
+    sks5 = [int(s) for s in rng.integers(1, 1 << 62, 8)]
+    raw5 = _sign_distinct_msgs(msgs5, sks5)
+    items5 = [(pk, sig, transcripts[k])
+              for k, (pk, _msg, sig) in enumerate(raw5)]
+    bad5 = list(items5)
+    k5 = len(bad5) // 2
+    bad5[k5] = (bad5[k5][0], bad5[k5][1], b"bench-corrupted-transcript")
+    out.append(_entry(
+        "dkg-share-verify-1000v-coldcache", 7, n5,
+        lambda: keygen.verify_share_proofs_multi(items5),
+        lambda: keygen.verify_share_proofs_multi(bad5)))
+    return out
 
 
 def _dkg_share_verify_workload(rng):

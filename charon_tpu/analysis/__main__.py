@@ -29,7 +29,8 @@ def main(argv=None) -> int:
                     help="audit a known-broken fixture instead of HEAD "
                          "(expected exit status: non-zero)")
     ap.add_argument("--trace", default="all",
-                    choices=["all", "straus", "dblsel", "pairing", "none"],
+                    choices=["all", "straus", "dblsel", "pairing", "h2c",
+                             "none"],
                     help="which kernels get the expensive traced passes "
                          "(grid arithmetic always covers all)")
     ap.add_argument("--no-shard", action="store_true",

@@ -40,6 +40,10 @@ TRACE_SETS = {
     "pairing": ("pallas_pairing.pp_dbl", "pallas_pairing.pp_add",
                 "pallas_pairing.pp_sqr", "pallas_pairing.pp_mul014",
                 "pallas_pairing.pp_f12mul", "pallas_pairing.pp_g1_dblsel"),
+    "h2c": ("pallas_h2c.h2c_sswu", "pallas_h2c.h2c_sqr",
+            "pallas_h2c.h2c_mul", "pallas_h2c.h2c_sqr4",
+            "pallas_h2c.h2c_sqr4mul", "pallas_h2c.h2c_iso3",
+            "pallas_h2c.h2c_psi"),
 }
 
 # process-lifetime cache: (kernel name, tile rows) -> closed jaxpr
@@ -171,6 +175,15 @@ def audit_kernel(spec: registry.KernelSpec, s_rows_list, *,
             except ValueError as exc:
                 audit.violations.append(f"{spec.name} at S={s_rows}: {exc}")
                 continue
+        elif spec.family == "h2c":
+            try:
+                tile = vb.pick_tile_rows_h2c(spec.n_in_planes,
+                                             spec.n_out_planes, s_rows,
+                                             with_digits=spec.with_digits,
+                                             budget=budget)
+            except ValueError as exc:
+                audit.violations.append(f"{spec.name} at S={s_rows}: {exc}")
+                continue
         else:
             tile = vb.SUBLANES
         audit.tiles[s_rows] = tile
@@ -209,6 +222,10 @@ def audit_kernel(spec: registry.KernelSpec, s_rows_list, *,
         model_fn = functools.partial(vb.pairing_step_footprint_bytes,
                                      spec.n_in_planes, spec.n_out_planes,
                                      with_digits=spec.with_digits)
+    elif spec.family == "h2c":
+        model_fn = functools.partial(vb.h2c_step_footprint_bytes,
+                                     spec.n_in_planes, spec.n_out_planes,
+                                     with_digits=spec.with_digits)
     foot = audit_footprint(
         gm, spec.name, n_point_inputs=spec.n_point_inputs,
         with_digits=spec.with_digits, reconcile=spec.reconcile_budget,
@@ -241,6 +258,10 @@ def _shape_s_rows(family: str, shapes=None):
             if family == "pairing":
                 s_rows = backend_tpu.verify_audit_s_rows(v)
                 out.setdefault(s_rows, []).append((v, 2, "fused"))
+            elif family == "h2c":
+                for origin, s_rows in \
+                        backend_tpu.h2c_audit_s_rows(v).items():
+                    out.setdefault(s_rows, []).append((v, 2, origin))
             else:
                 for origin, s_rows in backend_tpu.audit_s_rows(v, t).items():
                     out.setdefault(s_rows, []).append((v, t, origin))
@@ -274,6 +295,7 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
 
     s_rows_map = _shape_s_rows("g2", shapes)
     pairing_map = _shape_s_rows("pairing", shapes)
+    h2c_map = _shape_s_rows("h2c", shapes)
     report.shapes_checked = sorted(
         {(v, t) for rows in s_rows_map.values() for (v, t, _) in rows})
     trace_names = (set() if trace == "none" else
@@ -288,6 +310,10 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
             # 8-row fallback keeps the kernel audited even with an
             # explicit g2-only shape override
             s_rows_list = list(pairing_map) or [8]
+        elif spec.family == "h2c":
+            # hash-to-G2 map/sqrt stage shapes per verify batch
+            # (registered by tbls/backend_tpu), same fallback rationale
+            s_rows_list = list(h2c_map) or [16]
         else:
             # fp kernels tile a fixed [NLIMBS, 8, 128] block; audit the
             # 1-tile and many-tile grids

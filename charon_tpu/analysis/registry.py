@@ -29,19 +29,22 @@ class KernelSpec:
     ``with_digits`` mirror the `ops/vmem_budget` model parameters for the
     VMEM reconciliation pass; ``reconcile_budget`` is False for families
     the calibrated model does not cover (they still get the dtype, grid,
-    and budget-ceiling checks).  The "pairing" family sizes its operands
-    in Fp limb PLANES instead of whole G2 points: ``n_in_planes`` /
-    ``n_out_planes`` mirror `vmem_budget.pairing_step_footprint_bytes`."""
+    and budget-ceiling checks).  The "pairing" and "h2c" families size
+    their operands in Fp limb PLANES instead of whole G2 points:
+    ``n_in_planes`` / ``n_out_planes`` mirror
+    `vmem_budget.pairing_step_footprint_bytes` /
+    `vmem_budget.h2c_step_footprint_bytes` (the h2c model adds the
+    grid-invariant hash-to-curve constant block)."""
 
     name: str                           # e.g. "pallas_g2.dbl3sel_s"
-    family: str                         # "g2" | "fp" | "pairing"
+    family: str                         # "g2" | "fp" | "pairing" | "h2c"
     n_point_inputs: int
     with_digits: bool
     build: Callable[[int], Callable[..., Any]]
     make_args: Callable[[int], tuple]
     reconcile_budget: bool = True
-    n_in_planes: int = 0                # pairing family only
-    n_out_planes: int = 0               # pairing family only
+    n_in_planes: int = 0                # pairing/h2c families only
+    n_out_planes: int = 0               # pairing/h2c families only
 
 
 @dataclass(frozen=True)
@@ -108,5 +111,6 @@ def ensure_populated() -> None:
     the registry; the imports are no-ops when already loaded."""
     from ..ops import pallas_fp  # noqa: F401
     from ..ops import pallas_g2  # noqa: F401
+    from ..ops import pallas_h2c  # noqa: F401
     from ..ops import pallas_pairing  # noqa: F401
     from ..tbls import backend_tpu  # noqa: F401

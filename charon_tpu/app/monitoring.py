@@ -275,6 +275,9 @@ class MonitoringAPI:
             info["pubkey_cache_hits"] = be.TPUBackend.pk_cache_hits
             info["pubkey_cache_misses"] = be.TPUBackend.pk_cache_misses
             info["hashed_msg_cache_entries"] = len(be.TPUBackend._HM_CACHE)
+            info["hashed_msg_cache_hits"] = be.TPUBackend.hm_cache_hits
+            info["hashed_msg_cache_misses"] = be.TPUBackend.hm_cache_misses
+            info["h2c_path"] = be.h2c_path()
         if self._tracer is not None:
             info["tracer"] = {"spans_buffered": len(self._tracer.spans),
                               "dropped_spans": self._tracer.dropped}

@@ -129,3 +129,15 @@ def verify_share_proofs(items, transcript_hash: bytes) -> list:
     share indices → [bool], ONE batched pairing verification."""
     msg = share_proof_msg(transcript_hash)
     return tbls.batch_verify([(ps, msg, sig) for ps, sig in items])
+
+
+def verify_share_proofs_multi(items) -> list:
+    """Cross-ceremony batched share-proof verification: items are
+    [(pubshare, proof_sig, transcript_hash)] with each proof signing ITS
+    OWN ceremony's transcript message → [bool], still ONE batched
+    pairing verification.  A coordinator validating many single-cluster
+    ceremonies at once sees per-item-DISTINCT messages — the cold-cache
+    hash-to-G2 workload the device h2c path (ops/pallas_h2c) exists for,
+    measured as the bench's config-5 cold-cache entry."""
+    return tbls.batch_verify(
+        [(ps, share_proof_msg(th), sig) for ps, sig, th in items])

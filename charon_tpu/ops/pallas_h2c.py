@@ -1,0 +1,709 @@
+"""Fused Pallas TPU kernels for batched hash-to-G2 (RFC 9380 SSWU suite).
+
+Rounds 5-6 moved decompression, the MSM combine and the RLC pairing check
+onto fused device kernels, leaving exactly one piece of per-message crypto
+on the host: `tbls/ref/hash_to_curve.hash_to_g2` — two Fp2 square-root
+exponentiations via Python `pow(·, ·, P)` bigints plus a ~636-bit scalar
+multiplication for the cofactor, milliseconds per message.  The backend's
+hashed-message cache hides this only when signing roots repeat; the
+selection-proof and DKG share-proof workloads (BASELINE configs 4 and 5)
+are per-validator-DISTINCT messages, so their cold-cache cost was seconds
+of host work per slot.  This module is the device half of the split:
+
+    host   expand_message_xmd + hash_to_field   (SHA-256, microseconds)
+    device SSWU onto E' → 3-isogeny → add → ψ-cofactor clearing
+
+over the persistent limbs-major tiled layout of ops/pallas_g2, whose
+in-kernel field library (lazy-Karatsuba Fp2, fold-reduction Fp) these
+kernels reuse directly — no second copy of the field arithmetic.
+
+Construction (Wahby–Boneh "Fast and simple constant-time hashing to the
+BLS12-381 elliptic curve" + RFC 9380 §6.6.2/§8.8.2), batched and
+branch-free:
+
+- `h2c_sswu` computes the SSWU fraction x = xn/xd on E' plus the two
+  sqrt candidates as ONE kernel: v1 = g'(x1)·xd (g'(x) = num/xd³, so
+  sqrt(v1)/xd² is the affine y — the xd³ trick turns the `sqrt_ratio`
+  of the RFC into a PLAIN Fp2 square root, no inversion), and
+  v2 = (Z·u²)³·v1 (the Wahby–Boneh identity g'(x2) = Z³u⁶·g'(x1)).
+- The Fp2 square root is Adj–Rodríguez-Henríquez Alg. 9 — two
+  fixed-exponent pows — run as a FIXED-ADDITION-CHAIN of fused kernels:
+  4-bit windows of the static exponent, `h2c_sqr4mul` (acc ← acc¹⁶·m,
+  five Fp2 products with every intermediate in VMEM) per non-zero
+  window, `h2c_sqr4` per zero window, table built once per pow by
+  `h2c_sqr`/`h2c_mul`.  Both u-candidates of both field elements ride
+  one chain (candidates stacked on the row axis).
+- One Fp2 inversion (xd⁻¹, for the affine y the isogeny consumes and the
+  RFC sgn0 sign fix) reuses the same chain machinery via the norm trick:
+  inv(a) = conj(a)·(a·conj(a))^(p−2) — the norm has zero imaginary part,
+  so the Fp pow runs through the Fp2 kernels unchanged.
+- `h2c_iso3` evaluates the 3-isogeny E' → E on the affine point by
+  Horner over the kᵢ coefficient table and emits a HOMOGENEOUS
+  PROJECTIVE point (Xo, Yo, Zo) = (xn'·yd', y·yn'·xd', xd'·yd') — no
+  inversion; the downstream group law (ops/pallas_g2, RCB complete
+  formulas) takes any representative.
+- Cofactor clearing is the Budroni–Pintore ψ-decomposition
+      h_eff·P = [x²−x−1]P + [x−1]ψ(P) + ψ²([2]P)
+  — NOT the naive 636-bit double-and-add: `h2c_psi` is two cheap
+  Frobenius conjugations + two constant multiplies, and the three
+  [|x|]-multiplies (x the 64-bit BLS parameter) run through the proven
+  `pallas_g2.dblsel` 2-bit-window kernels with a STATIC window schedule.
+
+Exactness boundaries (sgn0 parity, candidate-square tests, the ∞ guards
+of the isogeny denominators) run at the jnp level between kernel
+launches with the existing `ops/fp` exact-carry machinery — in-kernel
+they would need carry-lookahead primitives Mosaic has no business
+lowering.  sgn0(u) is computed host-side (the u integers are host
+values anyway).
+
+Every kernel's S tile is sized by `ops/vmem_budget.pick_tile_rows_h2c`
+(the pairing planes model + the grid-invariant h2c constant block — the
+SSWU/isogeny/ψ constants enter as a broadcast input tensor like the fold
+constants, because Pallas forbids captured array constants) and is
+registered with the charon_tpu/analysis auditor as family "h2c".  The
+pure-Python `tbls/ref` pipeline remains the oracle and the automatic
+fallback (`CHARON_TPU_H2C` in tbls/backend_tpu, mirroring
+`CHARON_TPU_MSM`/`CHARON_TPU_PAIRING`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import fp
+from . import pallas_g2 as pg
+from . import vmem_budget
+from ..tbls.ref import sswu as refsswu
+from ..tbls.ref.fields import BLS_X, FQ2, P
+
+NL = fp.NLIMBS
+LANES = pg.LANES
+SUBLANES = pg.SUBLANES
+
+# ---------------------------------------------------------------------------
+# The h2c constant table: SSWU map constants, 3-isogeny coefficients and
+# ψ-endomorphism constants as Fp limb planes, [H2C_CONST_PLANES, NL, 128]
+# broadcast across lanes (limb axis on sublanes — the fold_consts layout
+# that costs one unpadded block instead of a vreg broadcast).  Indexed by
+# Fp2 slot: constant i occupies planes (2i, 2i+1) = (c0, c1).
+# ---------------------------------------------------------------------------
+
+_HC_ONE = 0          # FQ2 one (for tv1 + 1)
+_HC_Z = 1            # SSWU Z = −(2 + u)
+_HC_A = 2            # A' of E'
+_HC_NEG_A = 3        # −A'  (x1 denominator: xd = −A'·tv1)
+_HC_ZA = 4           # Z·A' (the tv1 = 0 exceptional denominator)
+_HC_B = 5            # B' of E'
+_HC_XN = 6           # 6..9   isogeny x-numerator k1_0..k1_3
+_HC_XD = 10          # 10..11 x-denominator k2_0..k2_1 (monic, deg 2)
+_HC_YN = 12          # 12..15 y-numerator k3_0..k3_3
+_HC_YD = 16          # 16..18 y-denominator k4_0..k4_2 (monic, deg 3)
+_HC_PSI_CX = 19      # ψ x-constant (untwist-Frobenius-twist)
+_HC_PSI_CY = 20      # ψ y-constant
+
+
+def _fq2_rows(x: FQ2) -> list[np.ndarray]:
+    c0, c1 = x.coeffs
+    return [fp.to_limbs(int(c0) % P), fp.to_limbs(int(c1) % P)]
+
+
+def _build_hc() -> np.ndarray:
+    # ψ constants derived (and oracle-verified) once in ops/codec
+    from . import codec
+
+    consts = [FQ2.one(), refsswu.Z_SSWU, refsswu.A_PRIME,
+              -refsswu.A_PRIME, refsswu.Z_SSWU * refsswu.A_PRIME,
+              refsswu.B_PRIME]
+    consts += list(refsswu._XN)
+    consts += list(refsswu._XD[:2])
+    consts += list(refsswu._YN)
+    consts += list(refsswu._YD[:3])
+    consts += [codec._PSI_CX, codec._PSI_CY]
+    rows = [r for c in consts for r in _fq2_rows(c)]
+    return np.stack(rows).astype(np.int32)
+
+
+_HC_NP = _build_hc()
+HC_PLANES = _HC_NP.shape[0]
+assert HC_PLANES == vmem_budget.H2C_CONST_PLANES
+assert refsswu._XD[2] == FQ2.one() and refsswu._YD[3] == FQ2.one()
+
+
+def h2c_consts() -> np.ndarray:
+    """The `hc` kernel input: [HC_PLANES, NL, 128] (lane-broadcast, like
+    `pallas_g2.fold_consts`)."""
+    return np.ascontiguousarray(
+        np.broadcast_to(_HC_NP[:, :, None], (HC_PLANES, NL, LANES)))
+
+
+def _hc_load(hc_ref):
+    """Kernel-side hc: the [HC_PLANES, NL, LANES] block →
+    [HC_PLANES, NL, 1, LANES] (rows re-broadcast per constant use)."""
+    return hc_ref[...][:, :, None, :]
+
+
+def _hc_direct(hc):
+    """DIRECT mode: lane-invariant → collapse to [HC_PLANES, NL, 1, 1]."""
+    return hc[:, :, None, :1]
+
+
+def _cf2(hc, idx, like):
+    """Fp2 constant `idx` broadcast to the block shape of `like`
+    ([NL, rows, LANES])."""
+    return (jnp.broadcast_to(hc[2 * idx], like.shape),
+            jnp.broadcast_to(hc[2 * idx + 1], like.shape))
+
+
+def _planes(*els):
+    """Stack Fp limb planes into one [n, NL, rows, LANES] array."""
+    return jnp.concatenate([e[None] for e in els], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Kernel bodies (shared by the pallas kernels and the DIRECT forms, the
+# pallas_g2/pallas_pairing dispatch discipline)
+# ---------------------------------------------------------------------------
+
+def _sswu_body(fc, hc, u, w):
+    """SSWU fraction + sqrt candidates for one u block.
+
+    u [2, NL, rows, 128] (Fp2 element planes), w [rows, 128] the
+    host-computed tv1 = 0 exceptional flag (u = 0 or Z·u² = −1).
+    Out 10 planes: (xn, xd, zu2, v1, v2) where x1 = xn/xd on E',
+    v1 = g'(x1)·xd³·xd⁻²... precisely v1 = gx_num·xd with
+    gx_num = xn³ + A'·xn·xd² + B'·xd³ = g'(x1)·xd³, so
+    y1 = sqrt(v1)/xd², and v2 = (Z·u²)³·v1 (candidate 2: x2 = zu2·x1,
+    same denominator)."""
+    uu = (u[0], u[1])
+    z = _cf2(hc, _HC_Z, u[0])
+    a = _cf2(hc, _HC_A, u[0])
+    na = _cf2(hc, _HC_NEG_A, u[0])
+    za = _cf2(hc, _HC_ZA, u[0])
+    b = _cf2(hc, _HC_B, u[0])
+    one = _cf2(hc, _HC_ONE, u[0])
+    u2 = pg._f2sqr(fc, uu)
+    zu2 = pg._f2mul(fc, z, u2)
+    zu2sq = pg._f2sqr(fc, zu2)
+    tv1 = pg._f2add(fc, zu2sq, zu2)
+    xd_reg = pg._f2mul(fc, na, tv1)
+    excb = (w != 0)[None, :, :]
+    xd = (jnp.where(excb, za[0], xd_reg[0]),
+          jnp.where(excb, za[1], xd_reg[1]))
+    xn = pg._f2mul(fc, b, pg._f2add(fc, tv1, one))
+    xd2 = pg._f2sqr(fc, xd)
+    xd3 = pg._f2mul(fc, xd2, xd)
+    xn2 = pg._f2sqr(fc, xn)
+    xn3 = pg._f2mul(fc, xn2, xn)
+    gx_num = pg._f2add(
+        fc,
+        pg._f2add(fc, xn3, pg._f2mul(fc, a, pg._f2mul(fc, xn, xd2))),
+        pg._f2mul(fc, b, xd3))
+    v1 = pg._f2mul(fc, gx_num, xd)
+    zu2cu = pg._f2mul(fc, zu2sq, zu2)
+    v2 = pg._f2mul(fc, zu2cu, v1)
+    return _planes(*xn, *xd, *zu2, *v1, *v2)
+
+
+def _sqr_body(fc, a):
+    return _planes(*pg._f2sqr(fc, (a[0], a[1])))
+
+
+def _mul_body(fc, a, b):
+    return _planes(*pg._f2mul(fc, (a[0], a[1]), (b[0], b[1])))
+
+
+def _sqr4_body(fc, a):
+    acc = (a[0], a[1])
+    for _ in range(4):
+        acc = pg._f2sqr(fc, acc)
+    return _planes(*acc)
+
+
+def _sqr4mul_body(fc, a, m):
+    """One 4-bit window step of a fixed-exponent pow: acc ← acc¹⁶·m."""
+    acc = (a[0], a[1])
+    for _ in range(4):
+        acc = pg._f2sqr(fc, acc)
+    return _planes(*pg._f2mul(fc, acc, (m[0], m[1])))
+
+
+def _horner(fc, hc, x, idxs, monic: bool):
+    """Σ kᵢ·xⁱ by Horner; `idxs` are hc slots of k₀..k_deg (k_deg omitted
+    and implied 1 when monic)."""
+    if monic:
+        acc = pg._f2add(fc, x, _cf2(hc, idxs[-1], x[0]))
+        rest = idxs[:-1]
+    else:
+        acc = _cf2(hc, idxs[-1], x[0])
+        rest = idxs[:-1]
+    for i in reversed(rest):
+        acc = pg._f2add(fc, pg._f2mul(fc, acc, x), _cf2(hc, i, x[0]))
+    return acc
+
+
+def _iso3_body(fc, hc, xy):
+    """3-isogeny E' → E on an affine input point, projective output.
+
+    xy [4, NL, rows, 128] = (x, y) affine on E'.  Out 6 planes: the
+    homogeneous projective image (Xo, Yo, Zo) = (xn'·yd', y·yn'·xd',
+    xd'·yd') — ∞ (a zero denominator, measure-zero u values) surfaces as
+    Zo ≡ 0 and is fixed up to the exact (0 : 1 : 0) form by the caller."""
+    x = (xy[0], xy[1])
+    y = (xy[2], xy[3])
+    xnum = _horner(fc, hc, x, [_HC_XN + i for i in range(4)], monic=False)
+    xden = _horner(fc, hc, x, [_HC_XD + i for i in range(2)], monic=True)
+    ynum = _horner(fc, hc, x, [_HC_YN + i for i in range(4)], monic=False)
+    yden = _horner(fc, hc, x, [_HC_YD + i for i in range(3)], monic=True)
+    xo = pg._f2mul(fc, xnum, yden)
+    yo = pg._f2mul(fc, y, pg._f2mul(fc, ynum, xden))
+    zo = pg._f2mul(fc, xden, yden)
+    return _planes(*xo, *yo, *zo)
+
+
+def _psi_body(fc, hc, pt):
+    """ψ on homogeneous projective planes: (c_x·X̄, c_y·Ȳ, Z̄) — the
+    untwist-Frobenius-twist endomorphism (ops/codec.g2_psi, kernel form);
+    conjugation is one cheap spread-negation per imaginary plane."""
+    cx = _cf2(hc, _HC_PSI_CX, pt[0])
+    cy = _cf2(hc, _HC_PSI_CY, pt[0])
+    xb = (pt[0], pg._negf(fc, pt[1]))
+    yb = (pt[2], pg._negf(fc, pt[3]))
+    xo = pg._f2mul(fc, cx, xb)
+    yo = pg._f2mul(fc, cy, yb)
+    return _planes(*xo, *yo, pt[4], pg._negf(fc, pt[5]))
+
+
+# ---------------------------------------------------------------------------
+# Kernels + DIRECT forms
+# ---------------------------------------------------------------------------
+
+def _h2c_sswu_kernel(fc_ref, hc_ref, u_ref, w_ref, o_ref):
+    o_ref[...] = _sswu_body(pg._fc_load(fc_ref), _hc_load(hc_ref),
+                            u_ref[...], w_ref[...])
+
+
+def _h2c_sqr_kernel(fc_ref, hc_ref, a_ref, o_ref):
+    o_ref[...] = _sqr_body(pg._fc_load(fc_ref), a_ref[...])
+
+
+def _h2c_mul_kernel(fc_ref, hc_ref, a_ref, b_ref, o_ref):
+    o_ref[...] = _mul_body(pg._fc_load(fc_ref), a_ref[...], b_ref[...])
+
+
+def _h2c_sqr4_kernel(fc_ref, hc_ref, a_ref, o_ref):
+    o_ref[...] = _sqr4_body(pg._fc_load(fc_ref), a_ref[...])
+
+
+def _h2c_sqr4mul_kernel(fc_ref, hc_ref, a_ref, m_ref, o_ref):
+    o_ref[...] = _sqr4mul_body(pg._fc_load(fc_ref), a_ref[...], m_ref[...])
+
+
+def _h2c_iso3_kernel(fc_ref, hc_ref, xy_ref, o_ref):
+    o_ref[...] = _iso3_body(pg._fc_load(fc_ref), _hc_load(hc_ref),
+                            xy_ref[...])
+
+
+def _h2c_psi_kernel(fc_ref, hc_ref, p_ref, o_ref):
+    o_ref[...] = _psi_body(pg._fc_load(fc_ref), _hc_load(hc_ref), p_ref[...])
+
+
+#: name -> (kernel, input plane counts, output plane count, window plane?)
+_KERNEL_TABLE = {
+    "h2c_sswu": (_h2c_sswu_kernel, (2,), 10, True),
+    "h2c_sqr": (_h2c_sqr_kernel, (2,), 2, False),
+    "h2c_mul": (_h2c_mul_kernel, (2, 2), 2, False),
+    "h2c_sqr4": (_h2c_sqr4_kernel, (2,), 2, False),
+    "h2c_sqr4mul": (_h2c_sqr4mul_kernel, (2, 2), 2, False),
+    "h2c_iso3": (_h2c_iso3_kernel, (4,), 6, False),
+    "h2c_psi": (_h2c_psi_kernel, (6,), 6, False),
+}
+
+_DIRECT_FNS = {
+    "h2c_sswu": lambda fc, hc, u, w: _sswu_body(
+        pg._fc_direct(fc), _hc_direct(hc), u, w),
+    "h2c_sqr": lambda fc, hc, a: _sqr_body(pg._fc_direct(fc), a),
+    "h2c_mul": lambda fc, hc, a, b: _mul_body(pg._fc_direct(fc), a, b),
+    "h2c_sqr4": lambda fc, hc, a: _sqr4_body(pg._fc_direct(fc), a),
+    "h2c_sqr4mul": lambda fc, hc, a, m: _sqr4mul_body(
+        pg._fc_direct(fc), a, m),
+    "h2c_iso3": lambda fc, hc, xy: _iso3_body(
+        pg._fc_direct(fc), _hc_direct(hc), xy),
+    "h2c_psi": lambda fc, hc, p: _psi_body(
+        pg._fc_direct(fc), _hc_direct(hc), p),
+}
+
+
+def _build_call(kernel, in_planes: tuple, out_planes: int, with_w: bool,
+                s_rows: int, interpret: bool, budget: int):
+    """One pallas_call over plane-stack operands plus the two constant
+    blocks (fc, hc), its S tile sized by the h2c VMEM model."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tile = vmem_budget.pick_tile_rows_h2c(sum(in_planes), out_planes,
+                                          s_rows, with_digits=with_w,
+                                          budget=budget)
+
+    def plane_spec(n):
+        return pl.BlockSpec((n, NL, tile, LANES), lambda i: (0, 0, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    fc_spec = pl.BlockSpec((pg._FC_ROWS, NL, LANES), lambda i: (0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    hc_spec = pl.BlockSpec((HC_PLANES, NL, LANES), lambda i: (0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((tile, LANES), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+    in_specs = ([fc_spec, hc_spec] + [plane_spec(n) for n in in_planes]
+                + ([w_spec] if with_w else []))
+    return pl.pallas_call(
+        kernel,
+        grid=(s_rows // tile,),
+        in_specs=in_specs,
+        out_specs=plane_spec(out_planes),
+        out_shape=jax.ShapeDtypeStruct((out_planes, NL, s_rows, LANES),
+                                       jnp.int32),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _calls(s_blocks: int, interpret: bool, budget: int):
+    s_rows = s_blocks * SUBLANES
+    return {name: _build_call(kern, ins, outs, ww, s_rows, interpret, budget)
+            for name, (kern, ins, outs, ww) in _KERNEL_TABLE.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _direct_jit(name: str):
+    return jax.jit(_DIRECT_FNS[name])
+
+
+def _run(name: str, fc, hc, *args):
+    if pg.DIRECT:
+        return _direct_jit(name)(fc, hc, *args)
+    s = args[0].shape[2]
+    assert s % SUBLANES == 0, f"S={s} must be a multiple of {SUBLANES}"
+    call = _calls(s // SUBLANES, pg.INTERPRET, vmem_budget.budget_bytes())
+    return call[name](fc, hc, *args)
+
+
+# ---------------------------------------------------------------------------
+# jnp-level glue on tiled planes: exactness boundaries + small selects.
+# These run BETWEEN kernel launches (O(1) per batch) — equality, sgn0 and
+# zero tests need the ops/fp exact-carry machinery, which has no place
+# inside a Mosaic kernel body.
+# ---------------------------------------------------------------------------
+
+def _fc_host(fc):
+    """Collapsed fold-constant view for inline jnp field ops on tiled
+    planes (same layout trick as pallas_g2._fc_direct)."""
+    return fc[:, :, None, :1]
+
+
+def _rows_f2(t):
+    """[2, NL, S, 128] tiled Fp2 → [S, 128, 2, NL] limb-last rows (the
+    ops/tower layout the exact-carry helpers consume)."""
+    return jnp.transpose(t, (2, 3, 0, 1))
+
+
+def f2_eq_rows(a, b) -> jnp.ndarray:
+    """Exact Fp2 equality of two tiled elements → [S, 128] bool."""
+    from . import tower
+
+    return tower.f2_eq(_rows_f2(a), _rows_f2(b))
+
+
+def f2_eq_const_rows(a, const_planes: np.ndarray) -> jnp.ndarray:
+    """Exact equality against a host [2, NL] limb constant."""
+    from . import tower
+
+    return tower.f2_eq(_rows_f2(a), jnp.asarray(const_planes))
+
+
+def f2_is_zero_rows(a) -> jnp.ndarray:
+    from . import tower
+
+    return tower.f2_is_zero(_rows_f2(a))
+
+
+def f2_sgn0_rows(a) -> jnp.ndarray:
+    """RFC 9380 sgn0 (m = 2) of a tiled Fp2 batch → [S, 128] bool.
+    Needs the CANONICAL representative — parity of a redundant residue
+    means nothing — so this is one exact-carry canonicalisation."""
+    at = _rows_f2(a)
+    c0 = fp.canon_std(at[..., 0, :])
+    c1 = fp.canon_std(at[..., 1, :])
+    s0 = (c0[..., 0] & 1) == 1
+    z0 = jnp.all(c0 == 0, axis=-1)
+    s1 = (c1[..., 0] & 1) == 1
+    return s0 | (z0 & s1)
+
+
+def _f2_neg_t(fc, a):
+    """Negate a tiled Fp2 element at the jnp level."""
+    fcv = _fc_host(fc)
+    return _planes(pg._negf(fcv, a[0]), pg._negf(fcv, a[1]))
+
+
+def _pt_neg_t(fc, p):
+    """Negate tiled projective points (Y planes 2, 3)."""
+    fcv = _fc_host(fc)
+    return jnp.concatenate(
+        [p[0:2], pg._negf(fcv, p[2])[None], pg._negf(fcv, p[3])[None],
+         p[4:6]], axis=0)
+
+
+_F2_MINUS_ONE = np.stack([fp.to_limbs(P - 1), fp.ZERO])
+
+
+# ---------------------------------------------------------------------------
+# Drivers: fixed-exponent pow, Alg-9 sqrt, norm inversion
+# ---------------------------------------------------------------------------
+
+def _pow_digits(e: int) -> tuple[int, ...]:
+    """Base-16 digits of a positive exponent, MSB first (first nonzero) —
+    the static window schedule of the fixed addition chain."""
+    assert e > 0
+    return tuple(int(c, 16) for c in f"{e:x}")
+
+
+#: The three chain exponents: Alg-9's two pows and the Fermat inversion.
+EXP_SQRT_A1 = (P - 3) // 4
+EXP_SQRT_B = (P - 1) // 2
+EXP_INV = P - 2
+
+
+def f2_pow_rows(fc, hc, a, e: int):
+    """a^e over a tiled Fp2 batch for a compile-time exponent: a 15-entry
+    window table (14 launches) + one fused `sqr4mul`/`sqr4` launch per
+    4-bit window, MSB-first."""
+    tbl = [None, a, _run("h2c_sqr", fc, hc, a)]
+    for k in range(3, 16):
+        tbl.append(_run("h2c_mul", fc, hc, tbl[k - 1], a))
+    digs = _pow_digits(e)
+    acc = tbl[digs[0]]
+    for d in digs[1:]:
+        acc = (_run("h2c_sqr4mul", fc, hc, acc, tbl[d]) if d
+               else _run("h2c_sqr4", fc, hc, acc))
+    return acc
+
+
+def f2_sqrt_rows(fc, hc, v):
+    """Batched Fp2 square root (Adj–Rodríguez-Henríquez Alg. 9, the
+    proven ops/codec.f2_sqrt algorithm in tiled-kernel form).
+    → (root, ok [S, 128]); root is garbage where ok is False."""
+    a1 = f2_pow_rows(fc, hc, v, EXP_SQRT_A1)
+    alpha = _run("h2c_mul", fc, hc, _run("h2c_sqr", fc, hc, a1), v)
+    x0 = _run("h2c_mul", fc, hc, a1, v)
+    # branch 1: α = −1 ⇒ root = u·x0 = (−x0c1) + x0c0·u
+    root_u = _planes(pg._negf(_fc_host(fc), x0[1]), x0[0])
+    # branch 2: root = (α+1)^((p−1)/2) · x0
+    one0 = jnp.asarray(fp.ONE)[:, None, None]
+    ap1 = _planes(pg._addf(_fc_host(fc), alpha[0], one0), alpha[1])
+    b = f2_pow_rows(fc, hc, ap1, EXP_SQRT_B)
+    root_b = _run("h2c_mul", fc, hc, b, x0)
+    is_m1 = f2_eq_const_rows(alpha, _F2_MINUS_ONE)
+    root = jnp.where(is_m1[None, None], root_u, root_b)
+    ok = f2_eq_rows(_run("h2c_sqr", fc, hc, root), v)
+    return root, ok
+
+
+def f2_inv_rows(fc, hc, a):
+    """Batched Fp2 inversion via the norm: a⁻¹ = ā·(a·ā)^(p−2).  The norm
+    a·ā has value-zero imaginary part, so its Fermat pow runs through the
+    same Fp2 chain kernels (inv(0) = 0, the fp-layer convention)."""
+    ac = _planes(a[0], pg._negf(_fc_host(fc), a[1]))
+    n = _run("h2c_mul", fc, hc, a, ac)
+    ninv = f2_pow_rows(fc, hc, n, EXP_INV)
+    return _run("h2c_mul", fc, hc, ac, ninv)
+
+
+# ---------------------------------------------------------------------------
+# ψ-cofactor clearing
+# ---------------------------------------------------------------------------
+
+#: Static 2-bit window schedule of |x| (the 64-bit BLS parameter) for the
+#: pallas_g2.dblsel kernels — one shared scalar across all rows.
+_Z_WINDOWS = tuple((BLS_X >> (62 - 2 * i)) & 3 for i in range(32))
+assert BLS_X.bit_length() == 64
+
+
+def _zmul(fc, q):
+    """[|x|]Q over tiled rows: {Q, 2Q, 3Q} table + 32 fused dblsel steps
+    (the round-4/5 MSM kernels with a static window plane)."""
+    q2 = pg.dbl(fc, q)
+    q3 = pg.add(fc, q2, q)
+    sv = q.shape[2]
+    acc = pg.inf_tiled(sv)
+    for w in _Z_WINDOWS:
+        wp = jnp.full((sv, LANES), w, jnp.int32)
+        acc = pg.dblsel(fc, acc, q, q2, q3, wp)
+    return acc
+
+
+def clear_cofactor_rows(fc, hc, p):
+    """Budroni–Pintore fast clearing over tiled projective points:
+
+        h_eff·P = [x²−x−1]P + [x−1]ψ(P) + ψ²([2]P),   x = −|x|
+
+    i.e. ([x²]P + [|x|]P − P) + (−[|x|]ψ(P) − ψ(P)) + ψ²(2P): three
+    64-bit [|x|]-multiplies, three ψ launches, one doubling, five
+    complete additions.  Value-equal to `[h_eff]P` (the explicit RFC
+    scalar) for every rational point — pinned by the differential tests
+    against `tbls/ref/sswu.clear_cofactor_h_eff`."""
+    t0 = _zmul(fc, p)                      # [|x|]P
+    t1 = _zmul(fc, t0)                     # [x²]P
+    part1 = pg.add(fc, pg.add(fc, t1, t0), _pt_neg_t(fc, p))
+    psip = _run("h2c_psi", fc, hc, p)
+    xpsip = _zmul(fc, psip)
+    part2 = pg.add(fc, _pt_neg_t(fc, xpsip), _pt_neg_t(fc, psip))
+    part3 = _run("h2c_psi", fc, hc,
+                 _run("h2c_psi", fc, hc, pg.dbl(fc, p)))
+    return pg.add(fc, pg.add(fc, part1, part2), part3)
+
+
+# ---------------------------------------------------------------------------
+# Full pipeline driver
+# ---------------------------------------------------------------------------
+
+def map_to_g2_rows(fc, hc, u_t, exc_w, sgn_u):
+    """SSWU + sqrt + sign fix + 3-isogeny for a tiled u batch: one mapped
+    E point (projective planes) per u row.
+
+    u_t [2, NL, S, 128] tiled Fp2 u values, exc_w [S, 128] int32 host
+    tv1 = 0 flags, sgn_u [S, 128] int32 host sgn0(u).
+    → [6, NL, S, 128] projective points on E (NOT cofactor-cleared)."""
+    s = u_t.shape[2]
+    out = _run("h2c_sswu", fc, hc, u_t, exc_w)
+    xn, xd, zu2 = out[0:2], out[2:4], out[4:6]
+    v1, v2 = out[6:8], out[8:10]
+    # ONE chain for both candidates: candidate 2 rows stacked after
+    # candidate 1 on the S axis
+    root, ok = f2_sqrt_rows(fc, hc, jnp.concatenate([v1, v2], axis=2))
+    root1, root2 = root[:, :, :s], root[:, :, s:]
+    ok1 = ok[:s]
+    e1 = ok1[None, None]
+    x2n = _run("h2c_mul", fc, hc, zu2, xn)
+    xnum = jnp.where(e1, xn, x2n)
+    rootsel = jnp.where(e1, root1, root2)
+    # affine x, y via ONE inversion chain: x = xnum·xd⁻¹,
+    # y = sqrt(gx_num·xd)·xd⁻² (the xd³ fraction trick)
+    xdi = f2_inv_rows(fc, hc, xd)
+    x_aff = _run("h2c_mul", fc, hc, xnum, xdi)
+    y_aff = _run("h2c_mul", fc, hc, rootsel,
+                 _run("h2c_sqr", fc, hc, xdi))
+    # RFC sgn0 sign fix: sgn0(y) must equal sgn0(u)
+    flip = f2_sgn0_rows(y_aff) != (sgn_u != 0)
+    y_aff = jnp.where(flip[None, None], _f2_neg_t(fc, y_aff), y_aff)
+    pt = _run("h2c_iso3", fc, hc,
+              jnp.concatenate([x_aff, y_aff], axis=0))
+    # isogeny ∞ guard (zero denominator ⇒ Zo ≡ 0): replace the garbage
+    # numerator planes with the exact (0 : 1 : 0) representative the
+    # complete group law requires
+    inf_flag = f2_is_zero_rows(pt[4:6])
+    inf_pt = jnp.asarray(pg._INF_PLANES)[:, :, None, None]
+    return jnp.where(inf_flag[None, None], inf_pt, pt)
+
+
+def hash_to_g2_rows(fc, hc, u_t, exc_w, sgn_u):
+    """Full device hash-to-G2 pipeline over a u-major tiled batch.
+
+    The row layout is u-major: rows [0, S/2) hold u₀ of each message,
+    rows [S/2, S) hold u₁ (so the two mapped points are contiguous
+    S-slices and their addition is ONE kernel launch, the tree_sum_t
+    layout trick).  → [6, NL, S/2, 128] cleared G2 points, one per
+    message row."""
+    s = u_t.shape[2]
+    half = s // 2
+    if not pg.DIRECT:
+        assert half % SUBLANES == 0, \
+            f"S={s}: each u-half must land on the {SUBLANES}-sublane grid"
+    mapped = map_to_g2_rows(fc, hc, u_t, exc_w, sgn_u)
+    r = pg.add(fc, mapped[:, :, :half], mapped[:, :, half:])
+    return clear_cofactor_rows(fc, hc, r)
+
+
+# ---------------------------------------------------------------------------
+# Host-side message preparation (the surviving host half: SHA-256)
+# ---------------------------------------------------------------------------
+
+def pack_messages(msgs, dst: bytes, pad_to: int):
+    """expand_message_xmd + hash_to_field for a message batch, packed for
+    the device pipeline.
+
+    → (u_rows [2·pad_to, 2, NL] int32, exc [2·pad_to] int32,
+    sgn [2·pad_to] int32), u-major (row j·pad_to + k = u_j of message k).
+    Padding rows are u = 0, which IS the tv1 = 0 exceptional case — the
+    flag is set so the kernels stay branch-free on garbage rows (their
+    outputs are sliced off)."""
+    from ..tbls.ref.hash_to_curve import hash_to_field_fp2
+
+    m = len(msgs)
+    assert m <= pad_to
+    u_rows = np.zeros((2 * pad_to, 2, NL), np.int32)
+    exc = np.ones(2 * pad_to, np.int32)
+    sgn = np.zeros(2 * pad_to, np.int32)
+    for k, msg in enumerate(msgs):
+        u0, u1 = hash_to_field_fp2(msg, 2, dst)
+        for j, u in enumerate((u0, u1)):
+            r = j * pad_to + k
+            c0, c1 = (int(c) for c in u.coeffs)
+            u_rows[r, 0] = fp.to_limbs(c0)
+            u_rows[r, 1] = fp.to_limbs(c1)
+            zu2 = refsswu.Z_SSWU * (u * u)
+            tv1 = zu2 * zu2 + zu2
+            exc[r] = 1 if tv1.is_zero() else 0
+            sgn[r] = refsswu._sgn0(u)
+    return u_rows, exc, sgn
+
+
+def tile_u_rows(u_rows):
+    """[R, 2, NL] Fp2 rows → [2, NL, S, 128] tiled (R = S·128)."""
+    r = u_rows.shape[0]
+    assert r % LANES == 0
+    flat = u_rows.reshape(r, 2, NL).transpose(1, 2, 0)
+    return flat.reshape(2, NL, r // LANES, LANES)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-contract registration (charon_tpu.analysis): family "h2c" — the
+# auditor's jaxpr/VMEM passes trace each kernel at the budgeted tile and
+# reconcile the BlockSpec-derived footprint against
+# vmem_budget.h2c_step_footprint_bytes (the planes model + the
+# grid-invariant constant block).  tbls/backend_tpu registers the verify
+# batch shapes this family actually runs at.
+# ---------------------------------------------------------------------------
+
+def _register_kernels():
+    from ..analysis import registry as _reg
+
+    def _make(kernel, in_planes, out_planes, with_w):
+        def build(s_rows: int, interpret: bool = True):
+            return _build_call(kernel, in_planes, out_planes, with_w,
+                               s_rows, interpret, vmem_budget.budget_bytes())
+
+        def make_args(s_rows: int) -> tuple:
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
+            args = (i32(pg._FC_ROWS, NL, LANES), i32(HC_PLANES, NL, LANES))
+            args += tuple(i32(n, NL, s_rows, LANES) for n in in_planes)
+            return args + ((i32(s_rows, LANES),) if with_w else ())
+
+        return build, make_args
+
+    for name, (kernel, in_planes, out_planes, with_w) in \
+            _KERNEL_TABLE.items():
+        build, make_args = _make(kernel, in_planes, out_planes, with_w)
+        _reg.register_kernel(_reg.KernelSpec(
+            name=f"pallas_h2c.{name}", family="h2c",
+            n_point_inputs=len(in_planes), with_digits=with_w,
+            build=build, make_args=make_args,
+            n_in_planes=sum(in_planes), n_out_planes=out_planes))
+
+
+_register_kernels()

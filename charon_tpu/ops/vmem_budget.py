@@ -141,6 +141,58 @@ def pick_tile_rows_planes(n_in_planes: int, n_out_planes: int, s_rows: int,
                           with_digits: bool = False,
                           budget: int | None = None) -> int:
     """pick_tile_rows for the pairing family (plane-stack operands)."""
+    def foot(tile):
+        return pairing_step_footprint_bytes(n_in_planes, n_out_planes,
+                                            tile, with_digits)
+
+    return _search_tile(
+        foot, s_rows, budget,
+        f"pallas_pairing kernel with {n_in_planes}+{n_out_planes} planes")
+
+
+# ---------------------------------------------------------------------------
+# Hash-to-G2 kernel footprint model (ops/pallas_h2c).
+#
+# The h2c kernels are plane-stack kernels like the pairing family (an Fp2
+# element is 2 planes, an affine point 4, a projective point 6) with ONE
+# extra operand: the hash-to-curve constant table (SSWU A'/B'/Z, the
+# 3-isogeny coefficients, the ψ-endomorphism constants) enters every
+# kernel as a grid-invariant ``[H2C_CONST_PLANES, NLIMBS, LANES]`` block,
+# exactly like the fold-constant table — Pallas forbids captured array
+# constants, and the round-5 lesson says a broadcast constant operand is
+# VMEM that must be modelled, not hoped about.
+# ---------------------------------------------------------------------------
+
+#: Fp limb planes of the h2c constant table (21 Fp2 constants; asserted
+#: against the real table at ops/pallas_h2c import).
+H2C_CONST_PLANES = 42
+
+
+def h2c_const_block_bytes() -> int:
+    """The [H2C_CONST_PLANES, NLIMBS, LANES] int32 constant block (grid
+    invariant — held once, like the fold-constant block)."""
+    return H2C_CONST_PLANES * NLIMBS * LANES * INT32
+
+
+def h2c_step_footprint_bytes(n_in_planes: int, n_out_planes: int,
+                             tile_rows: int,
+                             with_digits: bool = False) -> int:
+    """Scoped-VMEM bytes one grid step of a pallas_h2c kernel holds live:
+    the pairing-family plane model plus the single-buffered h2c constant
+    block (flag planes — the SSWU exceptional-case mask — reuse the
+    digit-plane term)."""
+    return (pairing_step_footprint_bytes(n_in_planes, n_out_planes,
+                                         tile_rows, with_digits)
+            + h2c_const_block_bytes())
+
+
+def _search_tile(footprint_fn, s_rows: int, budget: int | None,
+                 what: str) -> int:
+    """The shared tile search: the largest S tile (rows, multiple of
+    SUBLANES, dividing `s_rows`) whose `footprint_fn(tile_rows)` stays
+    under the scoped-VMEM budget.  Raises if even the minimum 8-row tile
+    does not fit — the kernel family itself is over budget and no grid
+    shape can save it."""
     if s_rows % SUBLANES:
         raise ValueError(f"S={s_rows} rows not a multiple of {SUBLANES}")
     if budget is None:
@@ -148,20 +200,29 @@ def pick_tile_rows_planes(n_in_planes: int, n_out_planes: int, s_rows: int,
     best = 0
     tile = SUBLANES
     while tile <= s_rows:
-        if s_rows % tile == 0 and \
-                pairing_step_footprint_bytes(n_in_planes, n_out_planes,
-                                             tile, with_digits) <= budget:
+        if s_rows % tile == 0 and footprint_fn(tile) <= budget:
             best = tile
         tile += SUBLANES
     if not best:
-        need = pairing_step_footprint_bytes(n_in_planes, n_out_planes,
-                                            SUBLANES, with_digits)
         raise ValueError(
-            f"pallas_pairing kernel with {n_in_planes}+{n_out_planes} "
-            f"planes needs {need} B of scoped VMEM at the minimum 8-row "
-            f"tile, over the {budget} B budget ({_BUDGET_ENV} to raise "
-            f"it; hard limit {HARD_LIMIT_BYTES} B)")
+            f"{what} needs {footprint_fn(SUBLANES)} B of scoped VMEM at "
+            f"the minimum 8-row tile, over the {budget} B budget "
+            f"({_BUDGET_ENV} to raise it; hard limit "
+            f"{HARD_LIMIT_BYTES} B)")
     return best
+
+
+def pick_tile_rows_h2c(n_in_planes: int, n_out_planes: int, s_rows: int,
+                       with_digits: bool = False,
+                       budget: int | None = None) -> int:
+    """pick_tile_rows for the h2c family (plane stacks + constant table)."""
+    def foot(tile):
+        return h2c_step_footprint_bytes(n_in_planes, n_out_planes, tile,
+                                        with_digits)
+
+    return _search_tile(
+        foot, s_rows, budget,
+        f"pallas_h2c kernel with {n_in_planes}+{n_out_planes} planes")
 
 
 def pick_tile_rows(n_point_inputs: int, s_rows: int,
@@ -171,25 +232,12 @@ def pick_tile_rows(n_point_inputs: int, s_rows: int,
     whose per-grid-step footprint stays under the scoped-VMEM budget.
 
     Raises if even the minimum 8-row tile does not fit — that means the
-    kernel family itself is over budget and no grid shape can save it.
+    kernel family itself is over budget and no grid shape can save it
+    (`_search_tile`, shared with the planes/h2c pickers).
     """
-    if s_rows % SUBLANES:
-        raise ValueError(f"S={s_rows} rows not a multiple of {SUBLANES}")
-    if budget is None:
-        budget = budget_bytes()
-    best = 0
-    tile = SUBLANES
-    while tile <= s_rows:
-        if s_rows % tile == 0 and \
-                step_footprint_bytes(n_point_inputs, tile,
-                                     with_digits) <= budget:
-            best = tile
-        tile += SUBLANES
-    if not best:
-        need = step_footprint_bytes(n_point_inputs, SUBLANES, with_digits)
-        raise ValueError(
-            f"pallas_g2 kernel with {n_point_inputs} point inputs needs "
-            f"{need} B of scoped VMEM at the minimum 8-row tile, over the "
-            f"{budget} B budget ({_BUDGET_ENV} to raise it; hard limit "
-            f"{HARD_LIMIT_BYTES} B)")
-    return best
+    def foot(tile):
+        return step_footprint_bytes(n_point_inputs, tile, with_digits)
+
+    return _search_tile(
+        foot, s_rows, budget,
+        f"pallas_g2 kernel with {n_point_inputs} point inputs")

@@ -23,6 +23,7 @@ import functools
 import logging
 import math
 import os
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -31,13 +32,15 @@ import numpy as np
 from . import shamir
 from ..ops import codec
 from ..ops import curve as jcurve
+from ..ops import fp
 from ..ops import pairing as jpair
 from ..ops import pallas_g2
+from ..ops import pallas_h2c
 from ..ops import pallas_pairing
 from ..ops import tower
 from ..ops.curve import F2_OPS, FP_OPS, add_points, double_point
 from ..tbls.ref import curve as refcurve
-from ..tbls.ref.hash_to_curve import hash_to_g2
+from ..tbls.ref.hash_to_curve import DST_G2, hash_to_g2
 
 _NEG_G1 = jcurve.g1_pack([refcurve.neg(refcurve.G1_GEN)])[0]
 _G2_INF_BYTES = np.zeros(96, np.uint8)
@@ -148,6 +151,16 @@ def _msm_straus_normalize_kernel(pts, digits, t_count):
 #: previous-round path with a warning, never zero out the whole bench.
 _MSM_FALLBACK = False       # straus kernel failed → dblsel
 _PAIRING_FALLBACK = False   # fused pairing failed → jnp pairing kernels
+_H2C_FALLBACK = False       # device hash-to-G2 failed → host hashing
+
+
+def _note_h2c_failure(exc: Exception) -> None:
+    global _H2C_FALLBACK
+    _H2C_FALLBACK = True
+    logging.getLogger(__name__).warning(
+        "device hash-to-G2 path failed to compile/run (%s: %s) — falling "
+        "back to host-side hashing for the rest of this process",
+        type(exc).__name__, exc)
 
 
 def _note_straus_failure(exc: Exception) -> None:
@@ -380,6 +393,61 @@ def pairing_path(n: int = 2048) -> str:
     return "pallas-rlc" if _use_pairing_fused(n) else "jnp"
 
 
+# -- device hash-to-G2 (ops/pallas_h2c) --------------------------------------
+#
+# The last host-side crypto on the verify hot path: hashed-message cache
+# misses used to run the pure-Python RFC 9380 pipeline (two Fp2 sqrt
+# exponentiations as `pow(·, ·, P)` bigints + a 636-bit cofactor scalar
+# mul) per DISTINCT message — milliseconds each, seconds per slot for the
+# per-validator-distinct workloads (selection proofs, DKG share proofs).
+# The device path keeps only expand_message_xmd + hash_to_field on the
+# host (SHA-256, microseconds) and maps the packed u-values through the
+# batched SSWU + isogeny + ψ-cofactor kernel family.
+
+def _h2c_kind() -> str:
+    """CHARON_TPU_H2C: auto (device on TPU backends for non-tiny miss
+    batches) | 1 (force device) | 0 (host hashing)."""
+    return os.environ.get("CHARON_TPU_H2C", "auto")
+
+
+def _use_h2c(n_miss: int | None = None) -> bool:
+    if _H2C_FALLBACK:
+        return False
+    flag = _h2c_kind()
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    if n_miss is not None and n_miss < 8:
+        return False   # tiny miss batches: the 1,024-row tile floor wins
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def h2c_path() -> str:
+    """Which hash-to-G2 implementation serves hashed-message cache
+    misses right now: ``device`` (pallas_h2c, fallback latch included)
+    or ``host`` (the tbls/ref pure-Python pipeline)."""
+    return "device" if _use_h2c() else "host"
+
+
+def _h2c_pad(m: int) -> int:
+    """Message padding of the device h2c batch: u rows are u-major
+    halves on the pallas 8-sublane grid, so the message count pads to a
+    1,024 multiple (DIRECT mode has no sublane grid; 128 keeps the CPU
+    differential suites small)."""
+    floor = 128 if pallas_g2.DIRECT else 1024
+    return max(floor, _pad_pow2(m))
+
+
+@jax.jit
+def _h2c_normalize_kernel(out_t):
+    """Tiled cleared G2 points → normalized std-form affine planes."""
+    return codec.g2_normalize(pallas_g2.untile_points(out_t))
+
+
 @jax.jit
 def _pk_decompress_kernel(pk_x, pk_sign, pk_inf):
     """G1-only decompress (curve + subgroup + nontrivial) for pubkey
@@ -459,7 +527,16 @@ class TPUBackend:
         return self.batch_verify([(pk, msg, sig)])[0]
 
     def verify_path(self, n: int) -> str:
-        return pairing_path(n)
+        """Pairing implementation + CONFIGURED hash-to-G2 path of an
+        n-entry verify, e.g. ``pallas-rlc+h2c-dev`` — surfaced by the
+        BatchVerifier ``paths`` counters →
+        ``core_verify_launches_by_path``, so an induced h2c fallback
+        (latch → ``+h2c-host``) is visible on /metrics, not just in a
+        log line.  ``h2c-dev`` means the device path is ENABLED (knob +
+        backend + no latch); in auto mode a tiny miss batch (< 8
+        distinct messages) still hashes on the host — the per-batch
+        truth is the ``path`` attribute of each ``tpu/hm_miss`` span."""
+        return f"{pairing_path(n)}+h2c-{'dev' if _use_h2c() else 'host'}"
 
     def combine_path(self) -> str:
         return combine_path()
@@ -627,27 +704,113 @@ class TPUBackend:
                                    np.asarray(oinf))
         return [out[k].tobytes() for k in range(nv)]
 
-    _HM_CACHE: dict[bytes, np.ndarray] = {}
+    #: hashed-message cache: msg bytes → packed affine H(m) planes
+    #: [3, 2, 32].  Bounded LRU (move-to-front on hit, evict-oldest on
+    #: insert) — the old full clear() at capacity was a thundering-herd
+    #: recompute exactly when the cache was hottest.  NOTE the capacity
+    #: is a back-stop, not the performance story: the distinct-message
+    #: workloads (selection proofs, DKG share proofs) NEVER hit this
+    #: cache cold, which is why misses batch through the device
+    #: hash-to-G2 path below.
+    _HM_CACHE: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+    _HM_CACHE_MAX = 4096
+    #: cumulative cache efficacy counters (served at /debug/memory,
+    #: mirroring the decompressed-pubkey cache)
+    hm_cache_hits = 0
+    hm_cache_misses = 0
 
-    def _hash_point(self, msg: bytes) -> np.ndarray:
-        hm = self._HM_CACHE.get(msg)
-        if hm is None:
-            hm = jcurve.g2_pack([hash_to_g2(msg)])[0]
-            if len(self._HM_CACHE) > 4096:
-                self._HM_CACHE.clear()
-            self._HM_CACHE[msg] = hm
-        return hm
+    def _h2c_points_device(self, keys, dst: bytes = DST_G2) -> np.ndarray:
+        """Batched device hash-to-G2 for a distinct-message list: host
+        keeps expand_message_xmd + hash_to_field (SHA-256) and ships
+        packed u-values; SSWU, the 3-isogeny, the two-point add and the
+        ψ-cofactor clearing run through the ops/pallas_h2c kernel
+        family.  → [m, 3, 2, 32] packed affine planes, bit-identical to
+        ``jcurve.g2_pack([hash_to_g2(msg)])`` per message."""
+        m = len(keys)
+        pad = _h2c_pad(m)
+        u_rows, exc, sgn = pallas_h2c.pack_messages(keys, dst, pad)
+        s = 2 * pad // pallas_g2.LANES
+        fc = jnp.asarray(pallas_g2.fold_consts())
+        hc = jnp.asarray(pallas_h2c.h2c_consts())
+        out = pallas_h2c.hash_to_g2_rows(
+            fc, hc, jnp.asarray(pallas_h2c.tile_u_rows(u_rows)),
+            jnp.asarray(exc.reshape(s, pallas_g2.LANES)),
+            jnp.asarray(sgn.reshape(s, pallas_g2.LANES)))
+        xc0, xc1, yc0, yc1, inf = (np.asarray(a) for a in
+                                   _h2c_normalize_kernel(out))
+        planes = np.zeros((m, 3, 2, jcurve.fp.NLIMBS), np.int32)
+        live = ~inf[:m]
+        planes[:, 0, 0] = np.where(live[:, None], xc0[:m], 0)
+        planes[:, 0, 1] = np.where(live[:, None], xc1[:m], 0)
+        planes[:, 1, 0] = np.where(live[:, None], yc0[:m], fp.ONE_M)
+        planes[:, 1, 1] = np.where(live[:, None], yc1[:m], 0)
+        planes[:, 2, 0] = np.where(live[:, None], fp.ONE_M, 0)
+        return planes
+
+    def _hash_points(self, msgs) -> np.ndarray:
+        """[m msg bytes] → packed affine H(m) planes [m, 3, 2, 32] via
+        the LRU cache; misses are deduplicated and batch-hashed — on
+        device (CHARON_TPU_H2C auto/1, ops/pallas_h2c) with automatic
+        host fallback on kernel failure (the round-5 latch pattern),
+        else through the tbls/ref pure-Python pipeline."""
+        out = np.zeros((len(msgs), 3, 2, jcurve.fp.NLIMBS), np.int32)
+        cache = self._HM_CACHE
+        miss: dict[bytes, list] = {}
+        for k, msg in enumerate(msgs):
+            hm = cache.get(msg)
+            if hm is not None:
+                cache.move_to_end(msg)
+                out[k] = hm
+            else:
+                miss.setdefault(msg, []).append(k)
+        n_miss = sum(len(v) for v in miss.values())
+        type(self).hm_cache_hits += len(msgs) - n_miss
+        if not miss:
+            return out
+        # lazy import: same rationale as the pubkey-cache span below
+        from ..app.tracing import device_span
+
+        type(self).hm_cache_misses += n_miss
+        keys = list(miss)
+        path = "device" if _use_h2c(len(keys)) else "host"
+        with device_span("tpu/hm_miss", misses=len(keys), batch=len(msgs),
+                         path=path):
+            planes = None
+            if path == "device":
+                try:
+                    planes = self._h2c_points_device(keys)
+                except Exception as exc:
+                    # an h2c kernel regression degrades to host hashing
+                    # instead of failing every verify (round-5 lesson)
+                    _note_h2c_failure(exc)
+            if planes is None:
+                planes = np.stack(
+                    [jcurve.g2_pack([hash_to_g2(msg)])[0] for msg in keys])
+        for j, msg in enumerate(keys):
+            if len(cache) >= self._HM_CACHE_MAX:
+                cache.popitem(last=False)
+            cache[msg] = planes[j]
+            for k in miss[msg]:
+                out[k] = planes[j]
+        return out
 
     def batch_verify_bytes(self, entries) -> list[bool]:
         """entries: [(48-byte pk, msg bytes, 96-byte sig)] → [bool].
-        Message hashing is host-side and cached per distinct message (a slot
-        has few distinct signing roots across many validators); pubkey and
+
+        Message hashing: expand_message_xmd stays host-side (SHA-256);
+        cache misses are batch-mapped to G2 on device (ops/pallas_h2c,
+        ``CHARON_TPU_H2C`` auto/1/0 with a host-hashing fallback latch).
+        The LRU hashed-message cache only helps REPEATED-message slots
+        (attestations of one committee root); the workloads that matter
+        for the cold-cache cost — selection-proof batches and DKG
+        share-possession proofs — sign PER-VALIDATOR-DISTINCT messages,
+        which is exactly what the device path exists for.  Pubkey and
         signature decompression plus the pairing check run on device.
 
-        Default path on TPU backends: the fused pallas RLC batch check
-        (ops/pallas_pairing, one final exponentiation per batch); the jnp
-        per-row kernel remains the oracle, the small-batch path, and the
-        automatic fallback when the fused path cannot compile
+        Default pairing path on TPU backends: the fused pallas RLC batch
+        check (ops/pallas_pairing, one final exponentiation per batch);
+        the jnp per-row kernel remains the oracle, the small-batch path,
+        and the automatic fallback when the fused path cannot compile
         (CHARON_TPU_PAIRING, mirroring CHARON_TPU_MSM)."""
         n = len(entries)
         if n == 0:
@@ -674,13 +837,17 @@ class TPUBackend:
         sg_raw = np.broadcast_to(_G2_INF_BYTES, (v, 96)).copy()
         hms = np.zeros((v, 3, 2, jcurve.fp.NLIMBS), np.int32)
         length_ok = np.ones(v, bool)
+        hm_rows, hm_msgs = [], []
         for k, (pk, msg, sig) in enumerate(entries):
             if len(pk) != 48 or len(sig) != 96:
                 length_ok[k] = False  # malformed entry: invalid, not fatal
                 continue
             pk_raw[k] = np.frombuffer(pk, np.uint8)
             sg_raw[k] = np.frombuffer(sig, np.uint8)
-            hms[k] = self._hash_point(msg)
+            hm_rows.append(k)
+            hm_msgs.append(msg)
+        if hm_msgs:
+            hms[hm_rows] = self._hash_points(hm_msgs)
         pk_x, pk_sign, pk_inf, pk_bad = codec.g1_bytes_split(pk_raw)
         sg_xc0, sg_xc1, sg_sign, sg_inf, sg_bad = codec.g2_bytes_split(sg_raw)
         pks, sigs, dec_ok = _verify_decompress_kernel(
@@ -762,14 +929,18 @@ class TPUBackend:
         hms = np.zeros((v, 3, 2, jcurve.fp.NLIMBS), np.int32)
         host_ok = np.zeros(v, bool)
         pk_bytes = []
+        hm_rows, hm_msgs = [], []
         for k, (pk, msg, sig) in enumerate(entries):
             if len(pk) != 48 or len(sig) != 96:
                 pk_bytes.append(None)
                 continue  # malformed entry: invalid, not fatal
             pk_bytes.append(pk)
             sg_raw[k] = np.frombuffer(sig, np.uint8)
-            hms[k] = self._hash_point(msg)
+            hm_rows.append(k)
+            hm_msgs.append(msg)
             host_ok[k] = True
+        if hm_msgs:
+            hms[hm_rows] = self._hash_points(hm_msgs)
         pk_planes, pk_ok = self._pk_planes_cached(
             [pk for pk in pk_bytes if pk is not None])
         it = iter(range(len(pk_planes)))
@@ -850,6 +1021,16 @@ def verify_audit_s_rows(v: int) -> int:
     return rows // pallas_g2.LANES
 
 
+def h2c_audit_s_rows(v: int) -> dict[str, int]:
+    """Hash-to-G2 kernel S rows for one verify batch of v (all-distinct)
+    messages: the map stage runs 2 u-rows per message at the non-DIRECT
+    1,024-message pad, the sqrt stage stacks both SSWU candidates (2×
+    the map rows through one exponentiation chain)."""
+    pad = max(1024, _pad_pow2(v))
+    s_map = 2 * pad // pallas_g2.LANES
+    return {"map": s_map, "sqrt": 2 * s_map}
+
+
 def audit_s_rows(v: int, t: int, n_dev: int = 8) -> dict[str, int]:
     """Kernel S rows for one (V, T): the fused bytes path pads V to a
     1024-row multiple (t-major rows), the sharded path pads per-device V
@@ -883,6 +1064,16 @@ def _register_audit_entries():
         _reg.register_workload_shape(_reg.WorkloadShape(
             family="pairing", v=v, t=2, s_rows=verify_audit_s_rows(v),
             origin="fused"))
+        # hash-to-G2 stage shapes of the same verify batches (family
+        # "h2c"), plus the post-add point rows the cofactor clearing
+        # drives through the g2 kernel family
+        stages = h2c_audit_s_rows(v)
+        for origin, s_rows in stages.items():
+            _reg.register_workload_shape(_reg.WorkloadShape(
+                family="h2c", v=v, t=2, s_rows=s_rows, origin=origin))
+        _reg.register_workload_shape(_reg.WorkloadShape(
+            family="g2", v=v, t=1, s_rows=stages["map"] // 2,
+            origin="h2c"))
     _reg.register_shard_program(_reg.ShardProgramSpec(
         name="backend_tpu.straus_combine_sharded",
         build_local=_sharded_combine_local,

@@ -223,6 +223,49 @@ def test_signed_digit_rows_value_exact():
     assert (d[0] == 0).all()                  # zero scalar → all-zero digits
 
 
+def _signed_digit_rows_loop(bits: np.ndarray) -> np.ndarray:
+    """The pre-round-7 per-digit Python carry loop, kept verbatim as the
+    reference the vectorised carry-lookahead recode must match
+    bit-for-bit."""
+    r, nbits = bits.shape
+    pad = (-nbits) % 3
+    b = np.concatenate([np.zeros((r, pad), bits.dtype), bits], axis=1)
+    nd = b.shape[1] // 3
+    u = (b[:, ::-1][:, 0::3] * 1 + b[:, ::-1][:, 1::3] * 2
+         + b[:, ::-1][:, 2::3] * 4)
+    d = np.zeros((r, nd + 1), np.int32)
+    carry = np.zeros(r, np.int32)
+    for i in range(nd):
+        v = u[:, i] + carry
+        hi = v >= 4
+        d[:, i] = np.where(hi, v - 8, v)
+        carry = hi.astype(np.int32)
+    d[:, nd] = carry
+    return np.ascontiguousarray(d[:, ::-1])
+
+
+def test_signed_digit_rows_vectorized_bit_identical_to_loop():
+    """Round-5 verdict weak #10: the recode is now numpy column ops (the
+    cummax-anchor carry lookahead).  It must be BIT-IDENTICAL to the old
+    sequential loop — including on adversarial carry chains: long runs
+    of propagating digits (u = 3, bit pattern 011…) above a generating
+    digit, all-ones scalars, and widths that exercise the 3-bit pad."""
+    rng = np.random.default_rng(77)
+    cases = [rng.integers(0, 2, (512, 256)).astype(np.int32),
+             np.ones((4, 256), np.int32),
+             np.zeros((4, 256), np.int32),
+             rng.integers(0, 2, (64, 64)).astype(np.int32),   # pad ≠ 0
+             rng.integers(0, 2, (64, 63)).astype(np.int32)]
+    adv = np.zeros((2, 258), np.int32)
+    adv[:, -3:] = [1, 0, 0]                   # low digit 4: generates
+    adv[0, :255] = np.tile([0, 1, 1], 85)     # 85 propagating digits above
+    cases += [adv, adv[:, 2:]]
+    for bits in cases:
+        got = pallas_g2.signed_digit_rows(bits)
+        want = _signed_digit_rows_loop(bits)
+        assert np.array_equal(got, want)
+
+
 def _combine_case(t_count: int, nbits: int, seed: int):
     """Periodic t-major combine inputs + their pure-Python oracle.
 

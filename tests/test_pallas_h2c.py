@@ -1,0 +1,378 @@
+"""Device hash-to-G2 family (ops/pallas_h2c + the backend h2c path).
+
+Fast lane: layout round-trips, the h2c VMEM model + constant table, the
+fixed-addition-chain window schedule, CHARON_TPU_H2C path selection and
+the automatic fallback latch, the bounded-LRU hashed-message cache with
+its hit/miss counters, and a traced contract audit of the cheapest h2c
+kernel (the deep kernels are traced by the slow lane / CLI / bench
+preflight — shared process-wide trace cache).
+
+Slow lane (DIRECT mode, the bit-identical collapsed kernel math on CPU):
+the FULL device pipeline against `tbls/ref/hash_to_curve.hash_to_g2`
+(RFC 9380 J.10.1 suite DST + random messages — every coordinate
+bit-exact after canonicalisation), the ψ-cofactor decomposition against
+the explicit h_eff scalar on a NON-subgroup curve point, the sqrt chain
+against oracle Fp2 roots, END-TO-END cold-cache `api.batch_verify` on
+both CHARON_TPU_H2C settings (corrupted row included), and one
+interpret-mode kernel-plumbing check.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from charon_tpu.ops import curve as jcurve
+from charon_tpu.ops import fp
+from charon_tpu.ops import pallas_g2 as pg
+from charon_tpu.ops import pallas_h2c as ph
+from charon_tpu.ops import pallas_pairing as pp
+from charon_tpu.ops import vmem_budget as vb
+from charon_tpu.tbls import api, backend_tpu
+from charon_tpu.tbls.ref import bls, curve as refcurve, sswu as refsswu
+from charon_tpu.tbls.ref.fields import BLS_X, FQ2
+from charon_tpu.tbls.ref.hash_to_curve import DST_G2, hash_to_g2
+
+_J101_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+_J101_MSGS = [b"", b"abc", b"abcdef0123456789", b"q128_" + b"q" * 128,
+              b"a512_" + b"a" * 512]
+
+
+@pytest.fixture
+def direct_mode():
+    pg.DIRECT = True
+    yield
+    pg.DIRECT = False
+
+
+@pytest.fixture
+def reset_h2c(monkeypatch):
+    monkeypatch.setattr(backend_tpu, "_H2C_FALLBACK", False)
+    backend_tpu.TPUBackend._HM_CACHE.clear()
+    yield
+    backend_tpu._H2C_FALLBACK = False
+    backend_tpu.TPUBackend._HM_CACHE.clear()
+
+
+def _consts():
+    return (jnp.asarray(pg.fold_consts()), jnp.asarray(ph.h2c_consts()))
+
+
+def _device_hash(msgs, dst, pad=128):
+    """Run the DIRECT device pipeline and return oracle-format points."""
+    u_rows, exc, sgn = ph.pack_messages(msgs, dst, pad)
+    fc, hc = _consts()
+    s = 2 * pad // pg.LANES
+    out = ph.hash_to_g2_rows(
+        fc, hc, jnp.asarray(ph.tile_u_rows(u_rows)),
+        jnp.asarray(exc.reshape(s, pg.LANES)),
+        jnp.asarray(sgn.reshape(s, pg.LANES)))
+    return jcurve.g2_unpack(pg.untile_points(out)[:len(msgs)])
+
+
+# ---------------------------------------------------------------------------
+# Fast lane
+# ---------------------------------------------------------------------------
+
+def test_tile_u_rows_roundtrip():
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, 4096, (256, 2, fp.NLIMBS)).astype(np.int32)
+    t = ph.tile_u_rows(rows)
+    assert t.shape == (2, fp.NLIMBS, 2, 128)
+    assert (np.asarray(pp.untile_planes(jnp.asarray(t))) == rows).all()
+
+
+def test_hc_table_matches_model_and_reference():
+    hc = ph.h2c_consts()
+    assert hc.shape == (vb.H2C_CONST_PLANES, fp.NLIMBS, pg.LANES)
+    # spot-pin constants against the reference suite values
+    b0, b1 = refsswu.B_PRIME.coeffs
+    assert fp.from_limbs(hc[2 * ph._HC_B, :, 0]) == int(b0)
+    assert fp.from_limbs(hc[2 * ph._HC_B + 1, :, 0]) == int(b1)
+    za = refsswu.Z_SSWU * refsswu.A_PRIME
+    assert fp.from_limbs(hc[2 * ph._HC_ZA, :, 0]) == int(za.coeffs[0])
+    neg_a = -refsswu.A_PRIME
+    assert fp.from_limbs(hc[2 * ph._HC_NEG_A + 1, :, 0]) \
+        == int(neg_a.coeffs[1])
+
+
+def test_pow_digit_schedule_reconstructs_exponents():
+    for e in (ph.EXP_SQRT_A1, ph.EXP_SQRT_B, ph.EXP_INV, 1, 15, 16, 255):
+        digs = ph._pow_digits(e)
+        assert digs[0] != 0
+        acc = 0
+        for d in digs:
+            acc = acc * 16 + d
+        assert acc == e
+
+
+def test_z_window_schedule_reconstructs_bls_parameter():
+    acc = 0
+    for w in ph._Z_WINDOWS:
+        acc = acc * 4 + w
+    assert acc == BLS_X
+
+
+def test_h2c_vmem_model_fits_budget_at_registered_shapes():
+    """Every h2c kernel admits a tile under the default budget at every
+    registered map/sqrt stage shape (the round-5 bug class is a
+    ValueError here, long before any TPU sees the kernel)."""
+    from charon_tpu.analysis import registry
+
+    registry.ensure_populated()
+    shapes = {s.s_rows for s in registry.workload_shapes("h2c")}
+    assert shapes, "backend registered no h2c workload shapes"
+    for spec in registry.kernels():
+        if spec.family != "h2c":
+            continue
+        for s_rows in shapes:
+            tile = vb.pick_tile_rows_h2c(spec.n_in_planes,
+                                         spec.n_out_planes, s_rows,
+                                         with_digits=spec.with_digits)
+            assert tile % vb.SUBLANES == 0 and s_rows % tile == 0
+
+
+def test_h2c_path_selection(monkeypatch, reset_h2c):
+    """CHARON_TPU_H2C mirrors CHARON_TPU_PAIRING: auto routes on backend
+    + miss-batch size, 0/1 force, and a noted failure latches host."""
+    monkeypatch.setenv("CHARON_TPU_H2C", "1")
+    assert backend_tpu._use_h2c(1)
+    assert backend_tpu.h2c_path() == "device"
+    monkeypatch.setenv("CHARON_TPU_H2C", "0")
+    assert not backend_tpu._use_h2c(4096)
+    assert backend_tpu.h2c_path() == "host"
+    monkeypatch.setenv("CHARON_TPU_H2C", "auto")
+    # auto on the CPU test backend: host
+    assert not backend_tpu._use_h2c(4096)
+    # a failure latches the fallback even when forced on
+    monkeypatch.setenv("CHARON_TPU_H2C", "1")
+    backend_tpu._note_h2c_failure(RuntimeError("mosaic boom"))
+    assert not backend_tpu._use_h2c(4096)
+    assert backend_tpu.h2c_path() == "host"
+
+
+def test_h2c_failure_logs_warning(caplog, reset_h2c):
+    with caplog.at_level(logging.WARNING):
+        backend_tpu._note_h2c_failure(RuntimeError("scoped vmem"))
+    assert any("host-side hashing" in r.message for r in caplog.records)
+
+
+def test_verify_path_composes_h2c_path(monkeypatch, reset_h2c):
+    """The BatchVerifier path counter (→ core_verify_launches_by_path)
+    must show the h2c leg, so an induced fallback is visible on
+    /metrics."""
+    be = backend_tpu.TPUBackend()
+    monkeypatch.setenv("CHARON_TPU_PAIRING", "0")
+    monkeypatch.setenv("CHARON_TPU_H2C", "1")
+    assert be.verify_path(64) == "jnp+h2c-dev"
+    backend_tpu._note_h2c_failure(RuntimeError("induced"))
+    assert be.verify_path(64) == "jnp+h2c-host"
+
+
+def test_hm_cache_lru_bounded_eviction(monkeypatch, reset_h2c):
+    """Capacity evicts the LEAST-RECENTLY-USED entry — not the round-6
+    full clear() (a thundering-herd recompute exactly when the cache is
+    hottest) — and the hit/miss counters track efficacy."""
+    monkeypatch.setenv("CHARON_TPU_H2C", "0")
+    monkeypatch.setattr(backend_tpu.TPUBackend, "_HM_CACHE_MAX", 4)
+    be = backend_tpu.TPUBackend()
+    hits0 = backend_tpu.TPUBackend.hm_cache_hits
+    miss0 = backend_tpu.TPUBackend.hm_cache_misses
+    msgs = [b"lru-%d" % i for i in range(4)]
+    be._hash_points(msgs)                       # 4 misses
+    assert backend_tpu.TPUBackend.hm_cache_misses == miss0 + 4
+    be._hash_points([msgs[0]])                  # hit refreshes recency
+    assert backend_tpu.TPUBackend.hm_cache_hits == hits0 + 1
+    be._hash_points([b"lru-new"])               # evicts lru-1, not lru-0
+    assert len(be._HM_CACHE) == 4
+    assert msgs[0] in be._HM_CACHE and b"lru-new" in be._HM_CACHE
+    assert msgs[1] not in be._HM_CACHE
+
+
+def test_hm_cache_dedups_misses_within_batch(reset_h2c, monkeypatch):
+    monkeypatch.setenv("CHARON_TPU_H2C", "0")
+    be = backend_tpu.TPUBackend()
+    miss0 = backend_tpu.TPUBackend.hm_cache_misses
+    hits0 = backend_tpu.TPUBackend.hm_cache_hits
+    out = be._hash_points([b"dup", b"dup", b"dup"])
+    # one distinct message: three rows filled, counted as 3 misses
+    # (mirroring the pk-cache convention), ONE host hash
+    assert (out[0] == out[1]).all() and (out[0] == out[2]).all()
+    assert backend_tpu.TPUBackend.hm_cache_misses == miss0 + 3
+    assert len([m for m in be._HM_CACHE if m == b"dup"]) == 1
+    be._hash_points([b"dup"])
+    assert backend_tpu.TPUBackend.hm_cache_hits == hits0 + 1
+    # and the cached planes are exactly the host-hash packed planes
+    assert (out[0] == jcurve.g2_pack([hash_to_g2(b"dup")])[0]).all()
+
+
+def test_hm_miss_emits_device_span(reset_h2c, monkeypatch):
+    """A hashed-message miss batch is wrapped in a `tpu/hm_miss` span
+    carrying miss/batch/path attributes (the pk_decompress_miss
+    convention); hits emit nothing."""
+    from charon_tpu.app import tracing
+    from charon_tpu.app.tracing import Tracer
+
+    monkeypatch.setenv("CHARON_TPU_H2C", "0")
+    tr = Tracer()
+    tracing.set_global_tracer(tr)
+    try:
+        be = backend_tpu.TPUBackend()
+        be._hash_points([b"span-a", b"span-a", b"span-b"])
+        [span] = [s for s in tr.spans if s.name == "tpu/hm_miss"]
+        assert span.attrs == {"misses": 2, "batch": 3, "path": "host"}
+        assert span.end is not None
+        be._hash_points([b"span-a"])          # pure hit: no new span
+        assert len([s for s in tr.spans if s.name == "tpu/hm_miss"]) == 1
+    finally:
+        tracing.set_global_tracer(None)
+
+
+def test_h2c_sqr_kernel_contract_audit():
+    """Traced jaxpr/VMEM contract audit of the cheapest h2c kernel in
+    the fast lane (dtype discipline, BlockSpec divisibility, 0 B drift
+    against the h2c planes+const model); the deep kernels are covered by
+    the slow lane's trace-all and the bench preflight."""
+    from charon_tpu.analysis import registry
+    from charon_tpu.analysis.audit import audit_kernel
+
+    registry.ensure_populated()
+    spec = {k.name: k for k in registry.kernels()}["pallas_h2c.h2c_sqr"]
+    audit = audit_kernel(spec, [16, 32], trace=True)
+    assert not audit.violations, audit.violations
+    assert audit.body_eqns and audit.traced_tile
+    assert audit.drift_bytes == 0
+    assert audit.derived_bytes == audit.model_bytes
+
+
+# ---------------------------------------------------------------------------
+# Slow lane — DIRECT-mode differentials on CPU
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_hash_to_g2_device_matches_oracle_rfc_and_random(direct_mode):
+    """The acceptance differential: the FULL device pipeline (SSWU →
+    sqrt chain → isogeny → add → ψ-cofactor) is bit-identical to the
+    pure-Python RFC 9380 oracle on the J.10.1 suite messages and a batch
+    of random messages, under the J.10.1 DST and the production DST."""
+    msgs = list(_J101_MSGS) + [b"rand-%d" % i for i in range(251)]
+    got = _device_hash(msgs, _J101_DST, pad=256)
+    for m, g in zip(msgs, got):
+        assert g == hash_to_g2(m, _J101_DST), m
+    prod = [b"duty-%d" % i for i in range(16)]
+    got = _device_hash(prod, DST_G2)
+    for m, g in zip(prod, got):
+        assert g == hash_to_g2(m, DST_G2), m
+
+
+@pytest.mark.slow
+def test_clear_cofactor_matches_h_eff_scalar(direct_mode):
+    """The ψ-decomposition equals multiplication by the explicit RFC
+    h_eff scalar — checked on a subgroup point AND on a raw curve point
+    with full cofactor content (where a wrong ψ constant or a sign slip
+    in the decomposition cannot hide)."""
+    x = 1
+    pts = []
+    while len(pts) < 2:
+        xf = FQ2([x, 0])
+        y = (xf * xf * xf + refcurve.B2).sqrt()
+        if y is not None:
+            pts.append((xf, y))
+        x += 1
+    pts.append(hash_to_g2(b"already-in-g2"))
+    rows = np.broadcast_to(jcurve.g2_pack(pts[:1]),
+                           (128, 3, 2, fp.NLIMBS)).copy()
+    for k, pt in enumerate(pts):
+        rows[k] = jcurve.g2_pack([pt])[0]
+    fc, hc = _consts()
+    t = pp.tile_planes(jnp.asarray(rows.reshape(128, 6, fp.NLIMBS)))
+    out = ph.clear_cofactor_rows(fc, hc, t)
+    got = jcurve.g2_unpack(pg.untile_points(out)[:len(pts)])
+    for pt, g in zip(pts, got):
+        assert g == refsswu.clear_cofactor_h_eff(pt)
+        assert refcurve.in_g2(g)
+
+
+@pytest.mark.slow
+def test_sqrt_chain_differential(direct_mode):
+    """f2_sqrt_rows against the oracle field: squares recover an exact
+    root (ok = True), non-residues report ok = False."""
+    rng = np.random.default_rng(11)
+    els = [FQ2([int(rng.integers(1, 1 << 60)),
+                int(rng.integers(0, 1 << 60))]) for _ in range(4)]
+    squares = [e * e for e in els]
+    # plus a non-residue: a square times the known non-square Z_SSWU
+    rows = np.zeros((128, 2, fp.NLIMBS), np.int32)
+    vals = squares + [squares[0] * refsswu.Z_SSWU]
+    for k, v in enumerate(vals):
+        rows[k, 0] = fp.to_limbs(int(v.coeffs[0]))
+        rows[k, 1] = fp.to_limbs(int(v.coeffs[1]))
+    fc, hc = _consts()
+    root_t, ok = ph.f2_sqrt_rows(fc, hc, jnp.asarray(ph.tile_u_rows(rows)))
+    ok = np.asarray(ok).reshape(-1)
+    assert ok[:4].all() and not ok[4]
+    from charon_tpu.ops import tower
+
+    roots = tower.f2_unpack(np.asarray(ph._rows_f2(root_t)))[:4]
+    for v, r in zip(squares, roots):
+        assert r * r == v
+
+
+@pytest.mark.slow
+def test_batch_verify_cold_cache_both_h2c_paths(direct_mode, reset_h2c,
+                                                monkeypatch):
+    """END-TO-END acceptance check: all-distinct messages, cleared
+    hashed-message cache, per-entry accept/reject identical on
+    CHARON_TPU_H2C=0 (host) and =1 (device) — including a corrupted row
+    — and the cached planes are byte-identical between the paths."""
+    api.set_scheme("bls")
+    api.set_backend("tpu")
+    try:
+        msgs = [b"cold-distinct-%d" % i for i in range(12)]
+        sks = [5000 + i for i in range(12)]
+        entries = [(refcurve.g1_to_bytes(bls.sk_to_pk(sk)), m,
+                    refcurve.g2_to_bytes(bls.sign(sk, m)))
+                   for sk, m in zip(sks, msgs)]
+        entries[5] = (entries[5][0], b"cold-corrupted", entries[5][2])
+        want = [True] * 12
+        want[5] = False
+        verdicts, planes = {}, {}
+        for knob in ("0", "1"):
+            monkeypatch.setenv("CHARON_TPU_H2C", knob)
+            backend_tpu._H2C_FALLBACK = False
+            backend_tpu.TPUBackend._HM_CACHE.clear()
+            verdicts[knob] = api.batch_verify(entries)
+            planes[knob] = np.stack(
+                [backend_tpu.TPUBackend._HM_CACHE[m]
+                 for m in msgs if m in backend_tpu.TPUBackend._HM_CACHE])
+        assert verdicts["0"] == want
+        assert verdicts["0"] == verdicts["1"]
+        assert not backend_tpu._H2C_FALLBACK, \
+            "device path silently latched host fallback"
+        assert np.array_equal(planes["0"], planes["1"])
+    finally:
+        api.set_backend("cpu")
+
+
+@pytest.mark.slow
+def test_h2c_kernel_interpret_matches_direct(direct_mode):
+    """Pallas plumbing check: the h2c_mul kernel in interpret mode
+    (BlockSpecs, grid, VMEM) computes exactly the DIRECT collapsed
+    form."""
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.integers(0, 4096, (2, fp.NLIMBS, 8, 128),
+                                 ).astype(np.int32))
+    b = jnp.asarray(rng.integers(0, 4096, (2, fp.NLIMBS, 8, 128),
+                                 ).astype(np.int32))
+    fc, hc = _consts()
+    want = np.asarray(ph._run("h2c_mul", fc, hc, a, b))
+    pg.DIRECT = False
+    pg.INTERPRET = True
+    try:
+        got = np.asarray(ph._run("h2c_mul", fc, hc, a, b))
+    finally:
+        pg.INTERPRET = False
+        pg.DIRECT = True
+    assert (got == want).all()

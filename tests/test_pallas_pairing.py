@@ -41,9 +41,11 @@ def direct_mode():
 
 @pytest.fixture
 def reset_fallbacks():
+    backend_tpu._H2C_FALLBACK = False
     yield
     backend_tpu._MSM_FALLBACK = False
     backend_tpu._PAIRING_FALLBACK = False
+    backend_tpu._H2C_FALLBACK = False
 
 
 # ---------------------------------------------------------------------------
@@ -172,10 +174,14 @@ def test_straus_failure_latches_dblsel(monkeypatch, caplog,
 
 def test_verify_path_surfaces_through_api(monkeypatch, reset_fallbacks):
     monkeypatch.setenv("CHARON_TPU_PAIRING", "1")
+    monkeypatch.setenv("CHARON_TPU_H2C", "0")
     api.set_scheme("bls")
     api.set_backend("tpu")
     try:
-        assert api.verify_path(2048) == "pallas-rlc"
+        # round-7: the path string carries the hash-to-G2 leg too
+        assert api.verify_path(2048) == "pallas-rlc+h2c-host"
+        monkeypatch.setenv("CHARON_TPU_H2C", "1")
+        assert api.verify_path(2048) == "pallas-rlc+h2c-dev"
     finally:
         api.set_backend("cpu")
     assert api.verify_path(2048) == "cpu"

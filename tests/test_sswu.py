@@ -133,3 +133,38 @@ def test_hash_to_field_range():
     assert len(els) == 2
     for e in els:
         assert all(0 <= c < P for c in e.coeffs)
+
+
+@pytest.mark.slow
+def test_j101_point_vectors_pin_direct_device_forms():
+    """The same 20 RFC 9380 J.10.1 coordinates, recomputed by the DEVICE
+    hash-to-G2 pipeline (ops/pallas_h2c, DIRECT collapsed kernel math on
+    CPU): every coordinate must equal the RFC constant bit-exactly, so
+    the device SSWU/isogeny/ψ-cofactor kernels are pinned against the
+    spec itself, not just against the Python oracle."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as jcurve
+    from charon_tpu.ops import pallas_g2 as pg
+    from charon_tpu.ops import pallas_h2c as ph
+
+    msgs = [m for m, *_ in _J101_VECTORS]
+    prev = pg.DIRECT
+    pg.DIRECT = True
+    try:
+        pad = 128
+        u_rows, exc, sgn = ph.pack_messages(msgs, _J101_DST, pad)
+        fc = jnp.asarray(pg.fold_consts())
+        hc = jnp.asarray(ph.h2c_consts())
+        s = 2 * pad // pg.LANES
+        out = ph.hash_to_g2_rows(
+            fc, hc, jnp.asarray(ph.tile_u_rows(u_rows)),
+            jnp.asarray(exc.reshape(s, pg.LANES)),
+            jnp.asarray(sgn.reshape(s, pg.LANES)))
+        got = jcurve.g2_unpack(pg.untile_points(out)[:len(msgs)])
+    finally:
+        pg.DIRECT = prev
+    for (msg, xc0, xc1, yc0, yc1), pt in zip(_J101_VECTORS, got):
+        x, y = pt
+        assert list(x.coeffs) == [xc0, xc1], f"device x mismatch {msg[:12]!r}"
+        assert list(y.coeffs) == [yc0, yc1], f"device y mismatch {msg[:12]!r}"

@@ -32,6 +32,9 @@ EXPECTED_FP = {f"pallas_fp.{n}" for n in
 EXPECTED_PAIRING = {f"pallas_pairing.{n}" for n in
                     ("pp_dbl", "pp_add", "pp_sqr", "pp_mul014",
                      "pp_f12mul", "pp_g1_dblsel")}
+EXPECTED_H2C = {f"pallas_h2c.{n}" for n in
+                ("h2c_sswu", "h2c_sqr", "h2c_mul", "h2c_sqr4",
+                 "h2c_sqr4mul", "h2c_iso3", "h2c_psi")}
 
 
 def test_registry_population():
@@ -42,17 +45,23 @@ def test_registry_population():
     registry.ensure_populated()
     names = {k.name for k in registry.kernels()}
     assert EXPECTED_G2 <= names and EXPECTED_FP <= names
-    assert EXPECTED_PAIRING <= names
+    assert EXPECTED_PAIRING <= names and EXPECTED_H2C <= names
     vt = {(s.v, s.t) for s in registry.workload_shapes("g2")}
     assert (10_000, 7) in vt and (1, 1) in vt
     origins = {s.origin for s in registry.workload_shapes("g2")}
-    assert origins == {"fused", "sharded"}
+    # "h2c": the point rows the cofactor clearing drives through the g2
+    # kernels (round-7)
+    assert origins == {"fused", "sharded", "h2c"}
     assert {s.v for s in registry.workload_shapes("pairing")} >= {2048}
+    assert {s.v for s in registry.workload_shapes("h2c")} >= {1000, 2048}
+    assert {s.origin for s in registry.workload_shapes("h2c")} \
+        == {"map", "sqrt"}
     progs = {p.name for p in registry.shard_programs()}
     assert "backend_tpu.straus_combine_sharded" in progs
-    # the pairing TRACE_SET names every registered pairing kernel, so the
-    # bench preflight and the CLI cover the whole family
+    # the pairing/h2c TRACE_SETs name every registered kernel of their
+    # family, so the bench preflight and the CLI cover the whole family
     assert set(TRACE_SETS["pairing"]) == EXPECTED_PAIRING
+    assert set(TRACE_SETS["h2c"]) == EXPECTED_H2C
 
 
 def test_arithmetic_audit_clean_for_every_registered_shape():

@@ -100,6 +100,40 @@ def test_combine_off_curve_x_raises():
         api.threshold_combine([{1: good[1], 2: bytes(bad)}])
 
 
+def test_batch_verify_cold_cache_matches_cpu_oracle(monkeypatch):
+    """Round-7 acceptance: all-DISTINCT messages with a cleared
+    hashed-message cache — the cold-cache workload the device
+    hash-to-G2 path serves — must produce per-entry accept/reject
+    verdicts bit-identical to the CPU-backend oracle on BOTH
+    CHARON_TPU_H2C settings, including a corrupted row and a wrong-key
+    row."""
+    from charon_tpu.ops import pallas_g2 as pg
+    from charon_tpu.tbls import backend_tpu
+
+    msgs = [b"cold-oracle-%d" % i for i in range(8)]
+    sks = [4242 + i for i in range(8)]
+    entries = []
+    for sk, m in zip(sks, msgs):
+        entries.append((refcurve.g1_to_bytes(bls.sk_to_pk(sk)), m,
+                        refcurve.g2_to_bytes(bls.sign(sk, m))))
+    entries[3] = (entries[3][0], b"cold-oracle-corrupted", entries[3][2])
+    entries[6] = (entries[0][0], entries[6][1], entries[6][2])  # wrong key
+    api.set_backend("cpu")
+    oracle = api.batch_verify(entries)
+    api.set_backend("tpu")
+    assert oracle == [True, True, True, False, True, True, False, True]
+    for knob, direct in (("0", False), ("1", True)):
+        monkeypatch.setenv("CHARON_TPU_H2C", knob)
+        monkeypatch.setattr(pg, "DIRECT", direct)
+        monkeypatch.setattr(backend_tpu, "_H2C_FALLBACK", False)
+        backend_tpu.TPUBackend._HM_CACHE.clear()
+        assert api.batch_verify(entries) == oracle, f"H2C={knob}"
+        if knob == "1":
+            assert not backend_tpu._H2C_FALLBACK, \
+                "device h2c path silently fell back to host hashing"
+    backend_tpu.TPUBackend._HM_CACHE.clear()
+
+
 def test_verify_and_aggregate_on_tpu_backend():
     msg = b"verify-and-aggregate"
     tss, shares = api.generate_tss(2, 3, seed=b"vat")
