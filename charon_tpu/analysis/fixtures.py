@@ -24,6 +24,11 @@ tier-1 (tests/test_static_analysis.py) and demonstrable from the CLI
 - `float_leak`: a kernel whose body silently promotes limb math to
   float32 and calls a transcendental — the dtype-discipline pass must
   flag both.
+
+- `bad_buckets` / `unbounded_label`: metrics-lint golden-bads — a
+  non-monotone bucket ladder with an explicit +Inf, and guarded labels
+  (`reason`/`peer`) fed from interpolated runtime strings (the
+  unbounded-cardinality series factory).  Pure AST, no jax needed.
 """
 
 from __future__ import annotations
@@ -156,9 +161,43 @@ def replicated_carry_shard_spec() -> registry.ShardProgramSpec:
         cases=((2, backend_tpu.STRAUS_NWIN),))
 
 
+#: Metrics-lint golden-bad sources (audited via lint_sources, never
+#: imported).  Non-monotone ladder + explicit infinity in one; guarded
+#: labels minted from runtime strings in the other.
+BAD_BUCKETS_SRC = '''\
+reg.set_buckets("app_fixture_seconds", (0.1, 0.05, 1.0))
+reg.set_buckets("app_fixture_inf_seconds", (0.1, float("inf")))
+reg.observe("app_fixture_seconds", 0.2)
+'''
+
+UNBOUNDED_LABEL_SRC = '''\
+reg.inc("app_fixture_errors_total",
+        labels={"reason": f"timeout after {secs}s"})
+reg.set_gauge("app_fixture_peer_state", 1.0,
+              labels={"peer": host + ":" + str(port)})
+reg.observe("app_fixture_seconds", 0.1,
+            labels={"path": "{}/{}".format(a, b)})
+'''
+
+
+def lint_golden_bad(which: str):
+    """Run the metrics lint over one known-bad source fixture."""
+    from .metrics_lint import lint_sources
+
+    src = {"bad_buckets": BAD_BUCKETS_SRC,
+           "unbounded_label": UNBOUNDED_LABEL_SRC}[which]
+    return lint_sources({f"charon_tpu/golden_bad_{which}.py": src})
+
+
 def audit_golden_bad(which: str):
     """Audit one golden-bad fixture; the returned report must NOT be ok."""
     from .audit import AuditReport, audit_kernel
+
+    if which in ("bad_buckets", "unbounded_label"):
+        # pure-AST lint fixtures: no kernel registry (and no jax) needed
+        report = AuditReport()
+        report.metrics_lint = lint_golden_bad(which)
+        return report
 
     registry.ensure_populated()
     report = AuditReport()

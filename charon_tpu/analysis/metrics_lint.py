@@ -16,7 +16,17 @@ write surface), and fails on:
   with a counter of the same stem is the sneaky variant),
 - histogram/counter stem collisions: a histogram ``X`` expands to
   ``X_bucket``/``X_sum``/``X_count`` series, so another metric named
-  ``X_count`` (etc.) collides at scrape time.
+  ``X_count`` (etc.) collides at scrape time,
+- ``set_buckets`` literals that are not strictly-increasing finite
+  numbers (the render path appends the ``+Inf`` bucket itself, so an
+  explicit infinity — or a non-monotone ladder — is a config bug),
+- label-cardinality guard: guarded label keys (``reason``, ``peer``,
+  ``step``, ``path``, ``phase``, ``duty`` …) must carry values drawn
+  from bounded sets.  Statically that means NO interpolated strings —
+  f-strings, ``%``/``+`` string building, ``.format()``, ``repr()``,
+  ``str()`` of anything but a plain name/attribute — as label values:
+  one exception message interpolated into a ``reason`` label is an
+  unbounded series factory that OOMs the scraper, not a metric.
 
 Runs inside ``python -m charon_tpu.analysis`` (every audit includes it)
 and tier-1 (tests/test_static_analysis.py).  Pure AST — no imports of
@@ -39,6 +49,12 @@ ALLOWED_PREFIXES = ("charon_tpu_", "core_", "app_")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: Label keys whose values must come from BOUNDED sets (enum names, peer
+#: indices, pipeline phases).  An interpolated string under one of these
+#: keys mints a new series per distinct value — unbounded cardinality.
+GUARDED_LABEL_KEYS = ("reason", "peer", "step", "path", "phase", "duty",
+                      "duty_type", "node", "span", "error")
 
 #: The Registry implementation itself dispatches sample values through
 #: methods with the same names (`_Hist.observe(value)`) — implementation,
@@ -83,10 +99,86 @@ class MetricsLintReport:
                 f"sites — {status}")
 
 
+def _unbounded_label_value(value: ast.expr) -> str | None:
+    """Why this label-value expression is an unbounded-series factory, or
+    None if it passes.  The heuristic targets INTERPOLATION: names,
+    attributes, enum ``.name``/``.lower()`` chains and ``str(<name>)``
+    index formatting are fine; building strings out of runtime data is
+    not."""
+    if isinstance(value, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(value, ast.BinOp):
+        return "string arithmetic (+/%)"
+    if isinstance(value, ast.Call):
+        fn = value.func
+        if isinstance(fn, ast.Name) and fn.id == "repr":
+            return "repr(...)"
+        if isinstance(fn, ast.Attribute) and fn.attr == "format":
+            return ".format(...)"
+        if isinstance(fn, ast.Name) and fn.id == "str":
+            arg = value.args[0] if value.args else None
+            if not isinstance(arg, (ast.Name, ast.Attribute, ast.Constant)):
+                return "str() of a computed expression"
+    return None
+
+
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, report: MetricsLintReport):
         self._path = path
         self._report = report
+
+    def _check_labels(self, node: ast.Call, method: str) -> None:
+        """Label-cardinality guard over the ``labels={...}`` keyword."""
+        for kw in node.keywords:
+            if kw.arg != "labels" or not isinstance(kw.value, ast.Dict):
+                continue
+            for key, value in zip(kw.value.keys, kw.value.values):
+                if not (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    continue
+                if key.value not in GUARDED_LABEL_KEYS:
+                    continue
+                why = _unbounded_label_value(value)
+                if why is not None:
+                    self._report.violations.append(
+                        f"{self._path}:{node.lineno}: label "
+                        f"{key.value!r} passed to {method}() is {why} — "
+                        f"guarded labels must be drawn from a bounded "
+                        f"enum (literal, name, or enum .name), not "
+                        f"interpolated runtime data")
+
+    def _check_buckets(self, node: ast.Call) -> None:
+        """Histogram bucket config: strictly-increasing finite literals;
+        the render path appends +Inf itself."""
+        where = f"{self._path}:{node.lineno}"
+        bounds = node.args[1] if len(node.args) > 1 else None
+        if bounds is None:
+            return
+        if not isinstance(bounds, (ast.Tuple, ast.List)):
+            return  # computed bounds: out of static reach
+        values = []
+        for el in bounds.elts:
+            if (isinstance(el, ast.Constant)
+                    and isinstance(el.value, (int, float))
+                    and not isinstance(el.value, bool)
+                    and el.value == el.value  # not NaN
+                    and abs(el.value) != float("inf")):
+                values.append(float(el.value))
+            else:
+                self._report.violations.append(
+                    f"{where}: set_buckets() bound is not a finite "
+                    f"numeric literal — +Inf is appended by the renderer "
+                    f"and must not appear in the config")
+                return
+        if not values:
+            self._report.violations.append(
+                f"{where}: set_buckets() with an empty bucket ladder")
+            return
+        if any(nxt <= cur for cur, nxt in zip(values, values[1:])):
+            self._report.violations.append(
+                f"{where}: set_buckets() bounds are not strictly "
+                f"increasing: {values} — cumulative bucket counts would "
+                f"render non-monotone")
 
     def visit_Call(self, node: ast.Call) -> None:
         fn = node.func
@@ -103,6 +195,9 @@ class _Visitor(ast.NodeVisitor):
                     f"{self._path}:{node.lineno}: non-literal metric name "
                     f"passed to {fn.attr}() — metric names must be string "
                     f"literals so the lint (and grep) can see them")
+            self._check_labels(node, fn.attr)
+        if isinstance(fn, ast.Attribute) and fn.attr == "set_buckets":
+            self._check_buckets(node)
         self.generic_visit(node)
 
 

@@ -3,9 +3,12 @@ fields and topics.
 
 Mirrors reference app/log/ (zap-based structured logging with
 context-carried fields, log.go:44-148; config.go:88-141 for encoder
-selection).  The Loki push client is replaced by an injectable sink hook —
-the same role (ship structured records to an aggregator) without a
-bundled HTTP client.
+selection) including the Loki push client (app/log/loki/client.go:49-190):
+:class:`LokiSink` ships every structured record to a Loki
+``/loki/api/v1/push`` endpoint with the same discipline as the OTLP
+span exporter — bounded queue, batched async POSTs, drops and send
+failures COUNTED, never raised into the logging caller.  Configured via
+``CHARON_TPU_LOKI_ENDPOINT`` (``{node}`` expands to the node name).
 """
 
 from __future__ import annotations
@@ -17,10 +20,12 @@ import sys
 import time
 from typing import Any
 
+from . import otlp
+
 _ctx_fields: contextvars.ContextVar[dict] = contextvars.ContextVar(
     "log_fields", default={})
 
-_sinks: list = []  # external record sinks (Loki-equivalent hook)
+_sinks: list = []  # external record sinks (LokiSink et al.)
 
 
 def with_ctx(**fields) -> contextvars.Token:
@@ -34,8 +39,84 @@ def reset_ctx(token: contextvars.Token) -> None:
 
 
 def add_sink(fn) -> None:
-    """fn(record_dict) — e.g. a Loki-style shipper."""
+    """fn(record_dict) — e.g. a LokiSink."""
     _sinks.append(fn)
+
+
+def remove_sink(fn) -> None:
+    """Detach a sink installed with add_sink (app shutdown)."""
+    if fn in _sinks:
+        _sinks.remove(fn)
+
+
+class LokiSink(otlp.BoundedAsyncHTTPExporter):
+    """Loki push client (reference: app/log/loki/client.go:49-190).
+
+    Installed with :func:`add_sink`; every formatted record is enqueued
+    synchronously and a background task batches them into
+    ``POST /loki/api/v1/push`` JSON documents::
+
+        {"streams": [{"stream": {<labels>}, "values": [["<ns>", <line>]]}]}
+
+    The queue is BOUNDED: when full, records are dropped and counted
+    (``dropped`` + ``app_loki_dropped_records_total`` on the registry) —
+    and a dead/slow Loki only ever increments ``send_failures``; logging
+    callers never see an exception (same discipline as
+    ``otlp.AsyncHTTPSink``, the reference client's WaitGroup+channel
+    pattern)."""
+
+    def __init__(self, endpoint: str, labels: dict | None = None,
+                 registry=None, max_queue: int = 4096,
+                 batch_size: int = 256, flush_interval: float = 0.5,
+                 timeout: float = 5.0):
+        super().__init__(endpoint, registry=registry, max_queue=max_queue,
+                         batch_size=batch_size, flush_interval=flush_interval,
+                         timeout=timeout, default_port=3100,
+                         default_path="/loki/api/v1/push", kind="Loki")
+        self._labels = {str(k): str(v) for k, v in (labels or {}).items()}
+
+    def _encode_batch(self, batch: list) -> bytes:
+        values = []
+        for rec in batch:
+            ts = rec.get("ts", time.time())
+            values.append([str(int(float(ts) * 1e9)),
+                           json.dumps(rec, sort_keys=True, default=str)])
+        return json.dumps({"streams": [{
+            "stream": self._labels, "values": values}]}).encode()
+
+    def _count_drop(self) -> None:
+        self.dropped += 1
+        if self._registry is not None:
+            self._registry.inc("app_loki_dropped_records_total")
+
+
+def loki_sink_from_env(node_name: str = "", labels: dict | None = None,
+                       registry=None, environ=None) -> LokiSink | None:
+    """Build a LokiSink from the ``CHARON_TPU_LOKI_*`` env vars:
+
+    - ``CHARON_TPU_LOKI_ENDPOINT``  push URL, e.g.
+      ``http://loki:3100/loki/api/v1/push``; ``{node}`` expands to the
+      node name so one shared config serves every node.
+    - ``CHARON_TPU_LOKI_QUEUE``     queue bound (default 4096).
+    - ``CHARON_TPU_LOKI_FLUSH``     flush interval seconds (default 0.5).
+
+    Returns None when no endpoint is configured.  The stream labels are
+    the caller's `labels` plus ``node`` (the reporting node's identity,
+    same convention as the metrics registry const label)."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    endpoint = env.get("CHARON_TPU_LOKI_ENDPOINT", "")
+    if not endpoint:
+        return None
+    stream = dict(labels or {})
+    if node_name:
+        stream.setdefault("node", node_name)
+    return LokiSink(
+        endpoint.replace("{node}", node_name), labels=stream,
+        registry=registry,
+        max_queue=int(env.get("CHARON_TPU_LOKI_QUEUE", "4096")),
+        flush_interval=float(env.get("CHARON_TPU_LOKI_FLUSH", "0.5")))
 
 
 class _Formatter(logging.Formatter):

@@ -150,6 +150,24 @@ def _fmt_labels(labels: tuple) -> str:
 #: negotiate for (reference: promhttp's Content-Type).
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
+#: Readiness reason enum (reference: app/monitoringapi.go readyz error
+#: taxonomy).  Exactly one ``app_readiness{reason}`` series is 1 at any
+#: time, so "why is this node not ready" is answerable from /metrics
+#: alone — not just from a /readyz probe body.
+READINESS_REASONS = ("ok", "bn_down", "syncing", "mesh_degraded")
+
+
+def set_readiness(registry: "Registry", reason: str) -> None:
+    """Export the readiness enum gauge: 1 for the active reason, 0 for
+    the rest (unknown reasons map to the closest enum slot's 0s plus
+    themselves, keeping the family bounded to the enum + at most one
+    extra)."""
+    for r in READINESS_REASONS:
+        registry.set_gauge("app_readiness", 1.0 if r == reason else 0.0,
+                           labels={"reason": r})
+    if reason not in READINESS_REASONS:
+        registry.set_gauge("app_readiness", 1.0, labels={"reason": reason})
+
 PROFILE_MAX_SECONDS = 30.0
 
 #: jax.profiler trace state is PROCESS-global, so the in-flight guard
@@ -210,13 +228,24 @@ class MonitoringAPI:
                      query: dict) -> tuple[str, str, bytes]:
         text, js = "text/plain", "application/json"
         if path == "/metrics":
+            # refresh readiness on every scrape, not only on /readyz
+            # probes: the app's readyz hook exports the app_readiness
+            # enum gauge as a side effect, and a deployment scraped by
+            # Prometheus without an external prober must still see
+            # CURRENT readiness at /metrics
+            try:
+                self._readyz()
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                pass
             return ("200 OK", METRICS_CONTENT_TYPE,
                     self.registry.render().encode())
         if path == "/livez":
             return "200 OK", text, b"ok"
         if path == "/readyz":
+            # the body always carries the reason string ("ok" when ready)
+            # so a probe log line is self-explanatory without /metrics
             ok, reason = self._readyz()
-            return ("200 OK", text, b"ok") if ok else (
+            return ("200 OK", text, reason.encode()) if ok else (
                 "503 Service Unavailable", text, reason.encode())
         if path == "/enr":
             return "200 OK", text, self._identity.encode()

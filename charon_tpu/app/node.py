@@ -21,6 +21,7 @@ from ..core.fetcher import Fetcher
 from ..core.parsigdb import MemParSigDB
 from ..core.scheduler import Scheduler
 from ..core.sigagg import SigAgg
+from ..core.slotbudget import SlotBudget
 from ..core.tracker import Tracker
 from ..core.types import Duty, ParSignedDataSet, PubKey
 from ..core.validatorapi import ValidatorAPI
@@ -88,6 +89,24 @@ class Node:
         self._genesis_time = genesis_time
         self._slot_duration = slot_duration
 
+        # Slot-budget accountant: hand-off hooks subscribe BEFORE wire()
+        # so each timestamp is taken before the downstream edge runs
+        # (the threshold→sigagg edge awaits the whole combine otherwise).
+        self.slotbudget: SlotBudget | None = None
+        if registry is not None:
+            self.slotbudget = SlotBudget(
+                registry=registry,
+                slot_start_fn=lambda slot: (genesis_time
+                                            + slot * slot_duration),
+                budget_seconds=slot_duration)
+            self.scheduler.subscribe_duties(self.slotbudget.on_duty_scheduled)
+            self.fetcher.subscribe(self.slotbudget.on_fetched)
+            if hasattr(consensus, "subscribe"):
+                consensus.subscribe(self.slotbudget.on_consensus)
+            self.parsigdb.subscribe_threshold(self.slotbudget.on_threshold)
+            self.sigagg.subscribe(self.slotbudget.on_aggregated)
+            self.bcast.subscribe(self.slotbudget.on_broadcast)
+
         interfaces.wire(self.scheduler, self.fetcher, self.consensus,
                         self.dutydb, self.vapi, self.parsigdb, self.parsigex,
                         self.sigagg, self.aggsigdb, self.bcast,
@@ -113,6 +132,10 @@ class Node:
             parsigex.subscribe(self.tracker.on_parsig_external)
             self.parsigdb.subscribe_threshold(self.tracker.on_threshold)
             self.sigagg.subscribe(self.tracker.on_aggregated)
+            if self.slotbudget is not None:
+                # post-deadline report drives the phase decomposition +
+                # late-duty watchdog
+                self.tracker.subscribe(self.slotbudget.on_report)
 
             async def _register_deadline(duty: Duty, *_args) -> None:
                 if self.deadliner is not None:
@@ -151,6 +174,8 @@ class Node:
             self.aggsigdb.trim(duty)
             if hasattr(self.consensus, "trim"):
                 self.consensus.trim(duty)
+            if hasattr(self.parsigex, "trim"):
+                self.parsigex.trim(duty)
             self.scheduler.trim(duty)
             await self.tracker.analyse(duty)
 

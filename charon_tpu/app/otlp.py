@@ -155,54 +155,58 @@ class FileSink:
         self.flush()
 
 
-class AsyncHTTPSink:
-    """Batched async OTLP/HTTP(JSON) exporter with a BOUNDED queue.
+class BoundedAsyncHTTPExporter:
+    """Shared push-exporter discipline: synchronous enqueue into a BOUNDED
+    queue, a lazily-started background flush task, batched HTTP/1.0 JSON
+    POSTs, and failures counted — never raised into the instrumented
+    operation.  `AsyncHTTPSink` (OTLP spans) and `app.log.LokiSink` (log
+    records) are the two instances of this discipline.
 
-    Spans are enqueued synchronously at span end; a background task
-    drains the queue every `flush_interval` seconds and POSTs one export
-    request per batch.  When the queue is full the span is dropped and
-    counted (`dropped`, plus ``app_otlp_dropped_spans_total`` on the
-    registry if one is wired) — backpressure from a slow collector must
-    never block the duty pipeline.  A failed POST drops that batch too
-    (counted in `send_failures`); there is deliberately no retry queue.
+    Subclasses implement `_encode_batch(batch) -> bytes` and
+    `_count_drop()` (the latter so the drop-counter metric name stays a
+    literal at its call site for the metrics lint).
     """
 
-    def __init__(self, endpoint: str, resource_attrs: dict | None = None,
-                 registry=None, max_queue: int = 4096,
+    def __init__(self, endpoint: str, registry=None, max_queue: int = 4096,
                  batch_size: int = 512, flush_interval: float = 0.5,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, default_port: int = 4318,
+                 default_path: str = "/v1/traces", kind: str = "export"):
         u = urllib.parse.urlsplit(endpoint)
         if u.scheme != "http" or not u.hostname:
             raise ValueError(
-                f"OTLP endpoint must be an http:// URL, got {endpoint!r}")
+                f"{kind} endpoint must be an http:// URL, got {endpoint!r}")
         self._host = u.hostname
-        self._port = u.port or 4318
-        self._path = u.path or "/v1/traces"
-        self._resource = dict(resource_attrs or {})
+        self._port = u.port or default_port
+        self._path = u.path or default_path
+        self._kind = kind
         self._registry = registry
         self._max_queue = max_queue
         self._batch_size = max(1, batch_size)
         self._flush_interval = flush_interval
         self._timeout = timeout
-        self._queue: deque[Span] = deque()
+        self._queue: deque = deque()
         self._task: asyncio.Task | None = None
         self._closed = False
         self.dropped = 0
         self.exported = 0
         self.send_failures = 0
 
-    def __call__(self, span: Span) -> None:
+    def _encode_batch(self, batch: list) -> bytes:
+        raise NotImplementedError
+
+    def _count_drop(self) -> None:
+        self.dropped += 1
+
+    def __call__(self, item) -> None:
         if len(self._queue) >= self._max_queue:
-            self.dropped += 1
-            if self._registry is not None:
-                self._registry.inc("app_otlp_dropped_spans_total")
+            self._count_drop()
             return
-        self._queue.append(span)
+        self._queue.append(item)
         if self._task is None and not self._closed:
             try:
                 loop = asyncio.get_running_loop()
             except RuntimeError:
-                return  # no loop: spans accumulate until one exists
+                return  # no loop: items accumulate until one exists
             self._task = loop.create_task(self._flush_loop())
 
     async def _flush_loop(self) -> None:
@@ -214,15 +218,14 @@ class AsyncHTTPSink:
         while self._queue:
             batch = [self._queue.popleft()
                      for _ in range(min(self._batch_size, len(self._queue)))]
-            body = json.dumps(
-                export_request(batch, self._resource)).encode()
+            body = self._encode_batch(batch)
             try:
                 await asyncio.wait_for(self._post(body), self._timeout)
                 self.exported += len(batch)
             except Exception as exc:  # noqa: BLE001 — exporter must not raise
                 self.send_failures += 1
                 if self.send_failures == 1:
-                    _log.warning("OTLP export to %s:%s%s failed: %s",
+                    _log.warning("%s push to %s:%s%s failed: %s", self._kind,
                                  self._host, self._port, self._path, exc)
 
     async def _post(self, body: bytes) -> None:
@@ -251,6 +254,37 @@ class AsyncHTTPSink:
             except (asyncio.CancelledError, Exception):
                 pass
         await self._flush_once()
+
+
+class AsyncHTTPSink(BoundedAsyncHTTPExporter):
+    """Batched async OTLP/HTTP(JSON) exporter with a BOUNDED queue.
+
+    Spans are enqueued synchronously at span end; a background task
+    drains the queue every `flush_interval` seconds and POSTs one export
+    request per batch.  When the queue is full the span is dropped and
+    counted (`dropped`, plus ``app_otlp_dropped_spans_total`` on the
+    registry if one is wired) — backpressure from a slow collector must
+    never block the duty pipeline.  A failed POST drops that batch too
+    (counted in `send_failures`); there is deliberately no retry queue.
+    """
+
+    def __init__(self, endpoint: str, resource_attrs: dict | None = None,
+                 registry=None, max_queue: int = 4096,
+                 batch_size: int = 512, flush_interval: float = 0.5,
+                 timeout: float = 5.0):
+        super().__init__(endpoint, registry=registry, max_queue=max_queue,
+                         batch_size=batch_size, flush_interval=flush_interval,
+                         timeout=timeout, default_port=4318,
+                         default_path="/v1/traces", kind="OTLP")
+        self._resource = dict(resource_attrs or {})
+
+    def _encode_batch(self, batch: list) -> bytes:
+        return json.dumps(export_request(batch, self._resource)).encode()
+
+    def _count_drop(self) -> None:
+        self.dropped += 1
+        if self._registry is not None:
+            self._registry.inc("app_otlp_dropped_spans_total")
 
 
 # ---------------------------------------------------------------------------

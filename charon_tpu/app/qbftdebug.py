@@ -33,6 +33,12 @@ class SniffedInstance:
     started: float
     msgs: list = field(default_factory=list)
     decided: bool = False
+    # OTLP linkage: the duty's deterministic trace ID + the instance
+    # span's ID, so a /debug/qbft entry points straight at the matching
+    # trace in the collector (stamped by core.consensus when tracing is
+    # wired; empty without it).
+    trace_id: str = ""
+    span_id: str = ""
 
 
 class QBFTSniffer:
@@ -43,14 +49,15 @@ class QBFTSniffer:
         self._max_instances = max_instances
         self._max_msgs = max_msgs
 
-    def on_rule(self, duty: Duty):
+    def on_rule(self, duty: Duty, trace_id: str = "", span_id: str = ""):
         """Returns a qbft.Definition.on_rule hook bound to this duty."""
         key = str(duty)
 
         def hook(instance, process, round_, msg, rule) -> None:
             inst = self._instances.get(key)
             if inst is None:
-                inst = SniffedInstance(duty=key, started=time.time())
+                inst = SniffedInstance(duty=key, started=time.time(),
+                                       trace_id=trace_id, span_id=span_id)
                 self._instances[key] = inst
                 while len(self._instances) > self._max_instances:
                     self._instances.popitem(last=False)
@@ -77,6 +84,8 @@ class QBFTSniffer:
                 "duty": inst.duty,
                 "started": inst.started,
                 "decided": inst.decided,
+                "trace_id": inst.trace_id,
+                "span_id": inst.span_id,
                 "n_msgs": len(inst.msgs),
                 "msgs": [asdict(m) for m in inst.msgs],
             })

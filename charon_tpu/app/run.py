@@ -34,6 +34,7 @@ from ..core.parsigdb import MemParSigDB
 from ..core.priority import InfoSync, Prioritiser
 from ..core.scheduler import Scheduler
 from ..core.sigagg import SigAgg
+from ..core.slotbudget import SlotBudget
 from ..core.tracker import Tracker
 from ..core.types import (Duty, DutyType, ParSignedDataSet, PubKey,
                           pubkey_from_bytes)
@@ -46,9 +47,9 @@ from ..p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
                              P2PPriorityExchange)
 from ..p2p.transport import TCPMesh, mesh_params_from_definition
 from ..tbls import api as tbls
-from . import featureset, otlp, tracing
+from . import featureset, log as applog, otlp, tracing
 from .lifecycle import Manager, StartOrder, StopOrder
-from .monitoring import MonitoringAPI, Registry
+from .monitoring import MonitoringAPI, Registry, set_readiness
 from .qbftdebug import QBFTSniffer
 from .peerinfo import PeerInfo
 from .retry import Retryer, with_async_retry
@@ -130,9 +131,11 @@ class App:
         self.self_index = self_index
         share_idx = self_index + 1
 
-        # 3. transports
+        # 3. transports (per-peer byte/frame/latency/reconnect counters
+        #    ride the registry; reference: p2p/sender.go:53-110)
         self.mesh = TCPMesh(self_index, peers, identity, pubs,
-                            cluster_hash=cluster_hash)
+                            cluster_hash=cluster_hash,
+                            registry=self.registry)
         self.mesh.enable_ping_responder()
 
         # 4. beacon client + chain parameters
@@ -183,6 +186,16 @@ class App:
             self.tracer_spans.add_sink(sink)
         tracing.set_global_tracer(self.tracer_spans)
 
+        # 5c. Loki log push (reference: app/log/loki/client.go:49-190):
+        #     CHARON_TPU_LOKI_ENDPOINT ships every structured log record;
+        #     drops/failures are counted, never raised into log callers.
+        self._loki_sink = applog.loki_sink_from_env(
+            node_name=node_name, registry=self.registry,
+            labels={"cluster_hash": cluster_hash.hex()[:10],
+                    "cluster_name": definition.name})
+        if self._loki_sink is not None:
+            applog.add_sink(self._loki_sink)
+
         # 6. pubshare maps from the lock (app/app.go:327-376)
         pubshares_by_peer: dict[int, dict[PubKey, bytes]] = {
             i + 1: {pubkey_from_bytes(v.public_key): v.public_shares[i]
@@ -197,9 +210,15 @@ class App:
                           builder_api=cfg.builder_api)
         fetcher = Fetcher(self.eth2cl)
         self.qbft_sniffer = QBFTSniffer()
+        # QBFT telemetry: round metrics on the registry, one
+        # consensus/qbft/{slot} span per instance joining the duty's
+        # deterministic trace, sniffer entries stamped with the same IDs
         consensus = QBFTConsensus(P2PConsensusTransport(self.mesh),
                                   self_index, n,
-                                  sniffer=self.qbft_sniffer)
+                                  sniffer=self.qbft_sniffer,
+                                  registry=self.registry,
+                                  tracer=self.tracer_spans,
+                                  trace_id_fn=tracing.duty_trace_id)
         dutydb = MemDutyDB()
         # Shared micro-batching verifier: both partial-sig verify call-sites
         # — local-VC submissions (reference: core/validatorapi/
@@ -215,7 +234,8 @@ class App:
                             slots_per_epoch=self.slots_per_epoch,
                             verifier=self.verifier)
         parsigdb = MemParSigDB(threshold)
-        parsigex = P2PParSigEx(self.mesh, verify_fn=self._verify_external)
+        parsigex = P2PParSigEx(self.mesh, verify_fn=self._verify_external,
+                               registry=self.registry)
         sigagg = SigAgg(threshold, tracer=self.tracer_spans)
         aggsigdb = MemAggSigDB()
         bcast = Broadcaster(self.eth2cl, self.genesis_time,
@@ -228,6 +248,21 @@ class App:
         self.deadliner = Deadliner(deadline_fn)
         self.retryer = Retryer(deadline_fn)
 
+        # 7b. slot-budget accountant: hand-off hooks subscribe BEFORE
+        #     wire() so each timestamp lands before the downstream edge
+        #     runs (the threshold→sigagg edge awaits the whole combine)
+        self.slotbudget = SlotBudget(
+            registry=self.registry,
+            slot_start_fn=lambda slot: (self.genesis_time
+                                        + slot * self.slot_duration),
+            budget_seconds=self.slot_duration)
+        sched.subscribe_duties(self.slotbudget.on_duty_scheduled)
+        fetcher.subscribe(self.slotbudget.on_fetched)
+        consensus.subscribe(self.slotbudget.on_consensus)
+        parsigdb.subscribe_threshold(self.slotbudget.on_threshold)
+        sigagg.subscribe(self.slotbudget.on_aggregated)
+        bcast.subscribe(self.slotbudget.on_broadcast)
+
         interfaces.wire(sched, fetcher, consensus, dutydb, vapi, parsigdb,
                         parsigex, sigagg, aggsigdb, bcast,
                         with_tracing(self.tracer_spans),
@@ -238,7 +273,7 @@ class App:
 
         self.scheduler, self.dutydb, self.parsigdb = sched, dutydb, parsigdb
         self.aggsigdb, self.consensus, self.vapi = aggsigdb, consensus, vapi
-        self.bcast = bcast
+        self.bcast, self.parsigex = bcast, parsigex
 
         # 8. tracker rides every edge as an extra subscriber
         #    (reference: app/app.go:450 wireTracker)
@@ -254,6 +289,7 @@ class App:
         parsigdb.subscribe_threshold(self.tracker.on_threshold)
         sigagg.subscribe(self.tracker.on_aggregated)
         self.tracker.subscribe(self._on_duty_report)
+        self.tracker.subscribe(self.slotbudget.on_report)
 
         # 9. deadliner feeds: every scheduled/inbound duty gets a deadline
         async def _register_deadline(duty: Duty, *_args) -> None:
@@ -277,7 +313,8 @@ class App:
 
         # 11. peerinfo + monitoring
         self.peerinfo = PeerInfo(self.mesh, VERSION, cluster_hash,
-                                 interval=cfg.peerinfo_interval)
+                                 interval=cfg.peerinfo_interval,
+                                 registry=self.registry)
         self.monitoring = MonitoringAPI(
             self.registry, self._readyz, identity=identity.enr(),
             qbft_debug=self.qbft_sniffer.render_json,
@@ -364,16 +401,26 @@ class App:
 
     def _readyz(self) -> tuple[bool, str]:
         """Quorum peers reachable AND beacon node synced
-        (reference: app/monitoringapi.go:100-176)."""
+        (reference: app/monitoringapi.go:100-176).  Also exports the
+        ``app_readiness{reason}`` enum gauge so not-ready is diagnosable
+        from /metrics, and the /readyz body carries the reason."""
+        reason, detail = self._readyz_reason()
+        set_readiness(self.registry, reason)
+        return reason == "ok", detail
+
+    def _readyz_reason(self) -> tuple[str, str]:
         n = self.lock.definition.num_operators
         quorum = (2 * n) // 3 + 1
         fresh = 1 + sum(1 for p, t in self._ping_ok.items()
                         if time.time() - t < 3 * self.cfg.ping_interval)
         if fresh < quorum:
-            return False, f"only {fresh}/{quorum} quorum peers reachable"
-        if not self._bn_synced:
-            return False, "beacon node not synced"
-        return True, "ok"
+            return ("mesh_degraded",
+                    f"only {fresh}/{quorum} quorum peers reachable")
+        if self._bn_state == "bn_down":
+            return "bn_down", "beacon node unreachable"
+        if self._bn_state == "syncing":
+            return "syncing", "beacon node not synced"
+        return "ok", "ok"
 
     def _load_vmock_keys(self, keystore_dir: str,
                          pubshares: dict[PubKey, bytes]):
@@ -405,6 +452,7 @@ class App:
             self.parsigdb.trim(duty)
             self.aggsigdb.trim(duty)
             self.consensus.trim(duty)
+            self.parsigex.trim(duty)
             self.scheduler.trim(duty)
             await self.tracker.analyse(duty)
 
@@ -424,9 +472,10 @@ class App:
         while True:
             try:
                 s = await self.eth2cl.node_syncing()
-                self._bn_synced = not s["is_syncing"]
+                self._bn_state = "syncing" if s["is_syncing"] else "ok"
             except Exception:
-                self._bn_synced = False
+                # unreachable ≠ syncing: distinct readiness reasons
+                self._bn_state = "bn_down"
             await asyncio.sleep(5.0)
 
     # -- lifecycle ----------------------------------------------------------
@@ -434,7 +483,7 @@ class App:
     def _register_lifecycle(self) -> None:
         life = self.life
         self._ping_ok: dict[int, float] = {}
-        self._bn_synced = True
+        self._bn_state = "ok"
 
         life.register_start(StartOrder.TRACKER, "deadliner",
                             self._start_deadliner)
@@ -486,6 +535,11 @@ class App:
                 await sink.aclose()
             elif hasattr(sink, "close"):
                 sink.close()
+        if self._loki_sink is not None:
+            # detach from the process-global sink list (other Apps in
+            # this process keep theirs), then final-drain the queue
+            applog.remove_sink(self._loki_sink)
+            await self._loki_sink.aclose()
 
     async def _stop_scheduler(self) -> None:
         self.scheduler.stop()

@@ -122,6 +122,14 @@ class Tracer:
                 "span export sink raised (counted, not re-raised; "
                 "further sink errors are logged at this counter only)")
 
+    def end_span(self, span: Span, **attrs) -> None:
+        """Finish a span that was started WITHOUT entering its context
+        manager — long-lived instance spans (a QBFT consensus instance
+        spans its whole lifetime) are ended from another task/callback,
+        where ``with`` scoping cannot apply."""
+        span.attrs.update(attrs)
+        self._finish(span)
+
     def trace(self, trace_id: str) -> list[Span]:
         return [s for s in self.spans if s.trace_id == trace_id]
 

@@ -22,6 +22,13 @@ class Broadcaster:
         self._slot_duration = slot_duration
         self._registry = registry  # app.monitoring.Registry (optional)
         self.broadcast_delays: list[tuple[Duty, float]] = []  # metric feed
+        self._subs: list = []
+
+    def subscribe(self, fn) -> None:
+        """fn(duty, pubkey, data) after a successful beacon-node submit —
+        the slot-budget accountant's bcast hand-off timestamp (internal
+        duty types are never broadcast and never notify)."""
+        self._subs.append(fn)
 
     async def broadcast(self, duty: Duty, pubkey: PubKey,
                         data: SignedData) -> None:
@@ -57,6 +64,8 @@ class Broadcaster:
                                    labels={"duty": duty.type.name.lower()})
             self._registry.inc("core_bcast_broadcast_total",
                                labels={"duty": duty.type.name.lower()})
+        for fn in self._subs:
+            await fn(duty, pubkey, data)
 
 
 class Recaster:

@@ -172,93 +172,108 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
     if d.is_leader(instance, round_, process) and input_value is not None:
         await broadcast(MsgType.PRE_PREPARE, input_value)
 
-    while True:
-        timeout = (None if decided_evt.is_set()
-                   else max(0.0, timer_deadline[0]
-                            - asyncio.get_event_loop().time()))
-        try:
-            msg = await asyncio.wait_for(t.receive.get(), timeout=timeout)
-        except asyncio.TimeoutError:
-            # Algorithm 3:1 — round timeout.
-            change_round(round_ + 1)
-            reset_timer()
+    # The timed receive is an explicit getter + asyncio.wait, NOT
+    # asyncio.wait_for: wait_for (3.8-3.11) returns the ready result and
+    # SWALLOWS an outer task.cancel() that lands while a message is
+    # queued — a cancelled-once instance (Deadliner trim, node shutdown,
+    # asyncio.run teardown) would keep looping and then block forever on
+    # the next empty-queue get, wedging event-loop shutdown.  wait()
+    # always re-raises cancellation; the finally reaps the getter.
+    getter: asyncio.Future | None = None
+    try:
+        while True:
+            timeout = (None if decided_evt.is_set()
+                       else max(0.0, timer_deadline[0]
+                                - asyncio.get_event_loop().time()))
+            if getter is None:
+                getter = asyncio.ensure_future(t.receive.get())
+            done, _ = await asyncio.wait({getter}, timeout=timeout)
+            if not done:
+                # Algorithm 3:1 — round timeout.
+                change_round(round_ + 1)
+                reset_timer()
+                if d.on_rule:
+                    d.on_rule(instance, process, round_, None,
+                              UponRule.ROUND_TIMEOUT)
+                await broadcast_round_change()
+                continue
+            msg = getter.result()
+            getter = None
+
+            if qcommit:
+                # Already decided: answer laggards (Algorithm 3:17).
+                if msg.source != process and msg.type == MsgType.ROUND_CHANGE:
+                    await t.broadcast(Msg(MsgType.DECIDED, instance, process,
+                                          qcommit[0].round, qcommit[0].value,
+                                          0, None, qcommit))
+                continue
+
+            if not is_justified(d, instance, msg):
+                continue
+
+            buffer_msg(msg)
+            rule, justification = classify(d, instance, round_, process,
+                                           buffer, msg)
+            if rule == UponRule.NOTHING or is_dup(rule, msg.round):
+                continue
             if d.on_rule:
-                d.on_rule(instance, process, round_, None,
-                          UponRule.ROUND_TIMEOUT)
-            await broadcast_round_change()
-            continue
+                d.on_rule(instance, process, round_, msg, rule)
 
-        if qcommit:
-            # Already decided: answer laggards (Algorithm 3:17).
-            if msg.source != process and msg.type == MsgType.ROUND_CHANGE:
-                await t.broadcast(Msg(MsgType.DECIDED, instance, process,
-                                      qcommit[0].round, qcommit[0].value, 0,
-                                      None, qcommit))
-            continue
+            if rule == UponRule.JUSTIFIED_PRE_PREPARE:      # Algorithm 2:1
+                # Note: change_round clears the dedup map, so a re-delivered
+                # PRE-PREPARE can re-fire this rule once after a round jump —
+                # intentional parity with the reference (duplicate PREPAREs
+                # are deduped per-source by receivers' quorum filters).
+                change_round(msg.round)
+                reset_timer()
+                await broadcast(MsgType.PREPARE, msg.value)
 
-        if not is_justified(d, instance, msg):
-            continue
+            elif rule == UponRule.QUORUM_PREPARES:          # Algorithm 2:4
+                prepared_round = round_
+                prepared_value = msg.value
+                prepared_justification = justification
+                await broadcast(MsgType.COMMIT, prepared_value)
 
-        buffer_msg(msg)
-        rule, justification = classify(d, instance, round_, process, buffer,
-                                       msg)
-        if rule == UponRule.NOTHING or is_dup(rule, msg.round):
-            continue
-        if d.on_rule:
-            d.on_rule(instance, process, round_, msg, rule)
+            elif rule in (UponRule.QUORUM_COMMITS,
+                          UponRule.JUSTIFIED_DECIDED):      # Algorithm 2:8
+                change_round(msg.round)
+                qcommit = justification
+                decided_value = msg.value
+                decided_evt.set()
+                if d.decide is not None:
+                    try:
+                        await d.decide(instance, msg.value, justification)
+                    except Exception:
+                        # A failing decide sink (e.g. a DutyDB slashing
+                        # clash) must not kill the instance: we still serve
+                        # DECIDED catch-ups to lagging peers.
+                        import logging
 
-        if rule == UponRule.JUSTIFIED_PRE_PREPARE:      # Algorithm 2:1
-            # Note: change_round clears the dedup map, so a re-delivered
-            # PRE-PREPARE can re-fire this rule once after a round jump —
-            # intentional parity with the reference (duplicate PREPAREs are
-            # deduped per-source by receivers' quorum filters).
-            change_round(msg.round)
-            reset_timer()
-            await broadcast(MsgType.PREPARE, msg.value)
+                        logging.getLogger("charon_tpu.qbft").exception(
+                            "decide callback failed for %s", instance)
+                # Like the reference, keep serving DECIDED to laggards until
+                # the caller cancels this instance (qbft.go:264-271).
 
-        elif rule == UponRule.QUORUM_PREPARES:          # Algorithm 2:4
-            prepared_round = round_
-            prepared_value = msg.value
-            prepared_justification = justification
-            await broadcast(MsgType.COMMIT, prepared_value)
+            elif rule == UponRule.F_PLUS_1_ROUND_CHANGES:   # Algorithm 3:5
+                change_round(next_min_round(d, justification, round_))
+                reset_timer()
+                await broadcast_round_change()
 
-        elif rule in (UponRule.QUORUM_COMMITS,
-                      UponRule.JUSTIFIED_DECIDED):      # Algorithm 2:8
-            change_round(msg.round)
-            qcommit = justification
-            decided_value = msg.value
-            decided_evt.set()
-            if d.decide is not None:
-                try:
-                    await d.decide(instance, msg.value, justification)
-                except Exception:
-                    # A failing decide sink (e.g. a DutyDB slashing clash)
-                    # must not kill the instance: we still serve DECIDED
-                    # catch-ups to lagging peers.
-                    import logging
+            elif rule == UponRule.QUORUM_ROUND_CHANGES:     # Algorithm 3:11
+                value = input_value
+                pr_pv = get_single_justified_pr_pv(d, justification)
+                if pr_pv is not None:
+                    _, pv = pr_pv
+                    if pv is not None:
+                        value = pv
+                if value is not None:  # non-leaders cannot propose
+                    await broadcast(MsgType.PRE_PREPARE, value, justification)
 
-                    logging.getLogger("charon_tpu.qbft").exception(
-                        "decide callback failed for %s", instance)
-            # Like the reference, keep serving DECIDED to laggards until the
-            # caller cancels this instance (reference: qbft.go:264-271).
-
-        elif rule == UponRule.F_PLUS_1_ROUND_CHANGES:   # Algorithm 3:5
-            change_round(next_min_round(d, justification, round_))
-            reset_timer()
-            await broadcast_round_change()
-
-        elif rule == UponRule.QUORUM_ROUND_CHANGES:     # Algorithm 3:11
-            value = input_value
-            pr_pv = get_single_justified_pr_pv(d, justification)
-            if pr_pv is not None:
-                _, pv = pr_pv
-                if pv is not None:
-                    value = pv
-            if value is not None:  # non-leading instances cannot propose
-                await broadcast(MsgType.PRE_PREPARE, value, justification)
-
-        elif rule == UponRule.UNJUST_QUORUM_ROUND_CHANGES:
-            pass  # ignore: bug or byzantine
+            elif rule == UponRule.UNJUST_QUORUM_ROUND_CHANGES:
+                pass  # ignore: bug or byzantine
+    finally:
+        if getter is not None:
+            getter.cancel()
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,7 @@ import asyncio
 import dataclasses
 
 from ..core import serialize
+from ..core.parsigex import EquivocationDetector
 from ..core.qbft import Msg
 from ..core.types import Duty, ParSignedDataSet
 from . import identity as ident
@@ -55,28 +56,47 @@ def verify_consensus_msg(msg: Msg, peer_pubkeys: dict[int, bytes],
 
 
 class P2PParSigEx:
-    """ParSigEx over the TCP mesh (reference: core/parsigex/parsigex.go)."""
+    """ParSigEx over the TCP mesh (reference: core/parsigex/parsigex.go).
 
-    def __init__(self, mesh, verify_fn=None):
+    With a registry, exports inbound/outbound message counters per duty
+    type and the per-sender-share equivocation counter (the mesh itself
+    exports the per-peer byte/frame/latency families)."""
+
+    def __init__(self, mesh, verify_fn=None, registry=None):
         self._mesh = mesh
         self._verify_fn = verify_fn
         self._subs: list = []
+        self._registry = registry
+        self._equiv = EquivocationDetector(registry)
         mesh.register_handler(PARSIGEX_PROTOCOL, self._on_frame)
 
     def subscribe(self, fn) -> None:
         self._subs.append(fn)
 
     async def broadcast(self, duty: Duty, pset: ParSignedDataSet) -> None:
+        if self._registry is not None:
+            self._registry.inc("core_parsigex_outbound_total",
+                               labels={"duty": duty.type.name.lower()})
         await self._mesh.broadcast(PARSIGEX_PROTOCOL,
                                    serialize.encode_parsig_set(duty, pset))
 
     async def _on_frame(self, sender: int, payload: bytes):
         duty, pset = serialize.decode_parsig_set(payload)
+        if self._registry is not None:
+            self._registry.inc("core_parsigex_inbound_total",
+                               labels={"duty": duty.type.name.lower()})
         if self._verify_fn is not None:
             await self._verify_fn(duty, pset)  # raises on invalid sigs
+        # pin AFTER verification: a forged set claiming another share's
+        # index must not mint false equivocation evidence
+        self._equiv.check(duty, pset)
         for fn in self._subs:
             await fn(duty, pset)
         return None
+
+    def trim(self, duty: Duty) -> None:
+        """Deadliner GC: drop the duty's equivocation pins."""
+        self._equiv.trim(duty)
 
 
 class P2PPriorityExchange:

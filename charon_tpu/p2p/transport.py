@@ -141,7 +141,7 @@ class TCPMesh:
     def __init__(self, self_index: int, peers: list[Peer],
                  node_identity: ident.NodeIdentity,
                  peer_pubkeys: dict[int, bytes],
-                 cluster_hash: bytes = b""):
+                 cluster_hash: bytes = b"", registry=None):
         self.self_index = self_index
         self.peers = {p.index: p for p in peers if p.index != self_index}
         self.self_peer = next(p for p in peers if p.index == self_index)
@@ -160,6 +160,50 @@ class TCPMesh:
         # failure hysteresis counters (reference: p2p/sender.go:53-110)
         self.send_failures: dict[int, int] = {}
         self.rtts: dict[int, float] = {}
+        # per-peer transport health metrics (reference: p2p/sender.go:53-110
+        # logs + p2p metrics.go counters); optional app.monitoring.Registry
+        self.registry = registry
+        self._ever_connected: set[int] = set()
+
+    # -- metrics helpers ----------------------------------------------------
+
+    def _count_sent(self, peer_index: int, nbytes: int,
+                    latency: float) -> None:
+        reg = self.registry
+        if reg is None:
+            return
+        peer = {"peer": str(peer_index)}
+        reg.inc("app_p2p_peer_sent_bytes_total", float(nbytes), labels=peer)
+        reg.inc("app_p2p_peer_sent_frames_total", labels=peer)
+        reg.observe("app_p2p_send_latency_seconds", latency, labels=peer)
+
+    def _count_recv(self, peer_index: int, nbytes: int) -> None:
+        reg = self.registry
+        if reg is None:
+            return
+        peer = {"peer": str(peer_index)}
+        reg.inc("app_p2p_peer_recv_bytes_total", float(nbytes), labels=peer)
+        reg.inc("app_p2p_peer_recv_frames_total", labels=peer)
+
+    def _count_send_result(self, peer_index: int, ok: bool) -> None:
+        """Surface the hysteresis state (consecutive-failure streak) plus a
+        monotonic failure counter."""
+        reg = self.registry
+        if reg is None:
+            return
+        peer = {"peer": str(peer_index)}
+        if not ok:
+            reg.inc("app_p2p_send_failures_total", labels=peer)
+        reg.set_gauge("app_p2p_send_failure_streak",
+                      float(self.send_failures.get(peer_index, 0)),
+                      labels=peer)
+
+    def _count_handshake_failure(self, peer_label: str) -> None:
+        if self.registry is not None:
+            # inbound failures happen before the peer authenticates, so
+            # the label is the constant "inbound" rather than an index
+            self.registry.inc("app_p2p_handshake_failures_total",
+                              labels={"peer": peer_label})
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -206,9 +250,11 @@ class TCPMesh:
             await self._send_frame(peer_index, protocol, payload,
                                    msg_id=self._next_id(), is_reply=False)
             self.send_failures[peer_index] = 0
+            self._count_send_result(peer_index, ok=True)
         except (OSError, asyncio.TimeoutError):
             self.send_failures[peer_index] = (
                 self.send_failures.get(peer_index, 0) + 1)
+            self._count_send_result(peer_index, ok=False)
 
     async def send_receive(self, peer_index: int, protocol: str,
                            payload: bytes, timeout: float = 5.0) -> bytes:
@@ -307,7 +353,13 @@ class TCPMesh:
             except (ConnectionError, asyncio.IncompleteReadError,
                     asyncio.TimeoutError) as e:
                 writer.close()
+                self._count_handshake_failure(str(peer_index))
                 raise ConnectionError(f"handshake with {peer_index}: {e}")
+            if self.registry is not None:
+                if peer_index in self._ever_connected:
+                    self.registry.inc("app_p2p_reconnects_total",
+                                      labels={"peer": str(peer_index)})
+                self._ever_connected.add(peer_index)
             self._channels[peer_index] = ch
             self._tasks.append(asyncio.get_event_loop().create_task(
                 self._read_loop(ch)))
@@ -322,10 +374,16 @@ class TCPMesh:
 
     async def _send_frame(self, peer_index: int, protocol: str,
                           payload: bytes, msg_id: int, is_reply: bool):
+        t0 = asyncio.get_event_loop().time()
         ch = await self._connect(peer_index)
-        ch.writer.write(ch.seal(self._encode_body(protocol, payload, msg_id,
-                                                  is_reply)))
+        frame = ch.seal(self._encode_body(protocol, payload, msg_id,
+                                          is_reply))
+        ch.writer.write(frame)
         await ch.writer.drain()
+        # latency covers connect (incl. handshake on a cold channel) +
+        # seal + kernel hand-off — the sender-side slot-budget cost
+        self._count_sent(peer_index, len(frame),
+                         asyncio.get_event_loop().time() - t0)
 
     async def _on_inbound(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -339,6 +397,7 @@ class TCPMesh:
         except (ConnectionError, asyncio.IncompleteReadError,
                 asyncio.TimeoutError, OSError):
             writer.close()
+            self._count_handshake_failure("inbound")
             return
         finally:
             if writer in self._raw_writers:
@@ -357,6 +416,7 @@ class TCPMesh:
                 body = ch.open(frame)
                 if body is None:
                     break  # forged/replayed frame: kill the connection
+                self._count_recv(ch.peer_index, 4 + len(frame))
                 await self._on_body(ch, body)
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
@@ -399,9 +459,13 @@ class TCPMesh:
             return
         reply = await handler(sender, payload)
         if reply is not None:
-            ch.writer.write(ch.seal(self._encode_body(protocol, reply,
-                                                      msg_id, is_reply=True)))
+            t0 = asyncio.get_event_loop().time()
+            frame = ch.seal(self._encode_body(protocol, reply, msg_id,
+                                              is_reply=True))
+            ch.writer.write(frame)
             await ch.writer.drain()
+            self._count_sent(ch.peer_index, len(frame),
+                             asyncio.get_event_loop().time() - t0)
 
 
 def mesh_params_from_definition(definition) -> tuple[list[Peer],

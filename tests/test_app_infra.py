@@ -151,12 +151,21 @@ def test_peerinfo_gossip_and_lock_mismatch():
         await m0.start()
         await m1.start()
         try:
-            pi0 = PeerInfo(m0, "v1.0", lock_hash=b"\x01" * 32)
+            reg = Registry()
+            pi0 = PeerInfo(m0, "v1.0", lock_hash=b"\x01" * 32, registry=reg)
             pi1 = PeerInfo(m1, "v0.9", lock_hash=b"\x02" * 32)  # mismatch
             await pi0.poll_once()
             assert pi0.peer_versions[1] == "v0.9"
             assert 1 in pi0.lock_mismatches
             assert abs(pi0.clock_skews[1]) < 1.0  # same host: tiny skew
+            # gossiped state reaches /metrics: per-peer clock skew gauge
+            # + version-mismatch counter
+            skew = reg._gauges[
+                ("app_peerinfo_clock_skew_seconds", (("peer", "1"),))]
+            assert abs(skew) < 1.0
+            assert reg._counters[
+                ("app_peerinfo_version_mismatch_total",
+                 (("peer", "1"),))] == 1.0
         finally:
             await m0.stop()
             await m1.stop()

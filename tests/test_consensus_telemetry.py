@@ -1,0 +1,246 @@
+"""Consensus-telemetry unit tests: QBFT round metrics on the registry,
+per-instance consensus/qbft spans joining the deterministic duty trace,
+trace/span-ID stamping of the /debug/qbft sniffer, and the parsigex
+message/equivocation/wire-byte counters on the in-memory transport."""
+
+import asyncio
+import json
+
+import pytest
+
+from charon_tpu.app.monitoring import Registry
+from charon_tpu.app.qbftdebug import QBFTSniffer
+from charon_tpu.app.tracing import Tracer, duty_trace_id
+from charon_tpu.core.consensus import (ConsensusMemNetwork, QBFTConsensus,
+                                       duty_leader)
+from charon_tpu.core.parsigex import (EquivocationDetector,
+                                      MemParSigExNetwork)
+from charon_tpu.core.types import (Duty, DutyType, ParSignedData,
+                                   SignedRandao)
+
+N = 3
+
+
+def build_cluster(registries=None, tracers=None, sniffers=None,
+                  timeout_base=0.2):
+    net = ConsensusMemNetwork()
+    nodes = [
+        QBFTConsensus(net, i, N, round_timeout_base=timeout_base,
+                      registry=registries[i] if registries else None,
+                      tracer=tracers[i] if tracers else None,
+                      sniffer=sniffers[i] if sniffers else None,
+                      trace_id_fn=duty_trace_id)
+        for i in range(N)]
+    return net, nodes
+
+
+def test_qbft_metrics_and_spans_on_decide():
+    registries = [Registry() for _ in range(N)]
+    tracers = [Tracer(r) for r in registries]
+    sniffers = [QBFTSniffer() for _ in range(N)]
+    duty = Duty(7, DutyType.ATTESTER)
+    value = {"pk": "unsigned"}
+
+    async def main():
+        _, nodes = build_cluster(registries, tracers, sniffers)
+        decided = [asyncio.Event() for _ in range(N)]
+        for i, node in enumerate(nodes):
+            async def on_decide(d, unsigned, i=i):
+                decided[i].set()
+            node.subscribe(on_decide)
+        for node in nodes:
+            await node.propose(duty, value)
+        await asyncio.wait_for(
+            asyncio.gather(*(e.wait() for e in decided)), 10.0)
+        # let the post-decide rule processing settle
+        await asyncio.sleep(0.05)
+        for node in nodes:
+            node.trim(duty)
+    asyncio.run(main())
+
+    tid = duty_trace_id(duty)
+    for i, (reg, tr) in enumerate(zip(registries, tracers)):
+        # decided counter + round-duration histogram per duty type
+        assert reg._counters[
+            ("core_qbft_decided_total", (("duty", "attester"),))] == 1.0
+        key = ("core_qbft_round_duration_seconds", (("duty", "attester"),))
+        assert reg._hist[key].count >= 1
+        # current-round gauge + one leader flagged among the peers
+        assert reg._gauges[
+            ("core_qbft_current_round", (("duty", "attester"),))] >= 1.0
+        leaders = [reg._gauges[("core_qbft_leader",
+                                (("duty", "attester"), ("peer", str(p))))]
+                   for p in range(N)]
+        assert sum(leaders) == 1.0
+        assert leaders[duty_leader(duty, 1, N)] == 1.0
+
+        # instance span: joins the duty trace, ended at decide
+        spans = [s for s in tr.spans
+                 if s.name == f"consensus/qbft/{duty.slot}"]
+        assert len(spans) == 1
+        span = spans[0]
+        assert span.trace_id == tid
+        assert span.end is not None
+        assert span.attrs["decided"] is True
+        assert span.attrs["rounds"] >= 1
+
+        # sniffer instances stamped with the SAME trace/span ids so
+        # /debug/qbft links to the OTLP trace
+        doc = json.loads(sniffers[i].render_json())
+        [inst] = doc["instances"]
+        assert inst["decided"] is True
+        assert inst["trace_id"] == tid
+        assert inst["span_id"] == span.span_id
+
+
+def test_qbft_timeouts_round_changes_and_undecided_span():
+    """A quorumless instance (single live node of 3) times out round
+    after round: timeout + round-change counters grow, and GC closes the
+    span as undecided."""
+    reg = Registry()
+    tr = Tracer(reg)
+    duty = Duty(9, DutyType.PROPOSER)
+
+    async def main():
+        net = ConsensusMemNetwork()
+        node = QBFTConsensus(net, 0, N, round_timeout_base=0.05,
+                             round_timeout_inc=0.01, registry=reg,
+                             tracer=tr, trace_id_fn=duty_trace_id)
+        await node.propose(duty, {"pk": "v"})
+        await asyncio.sleep(0.4)
+        node.trim(duty)
+        await asyncio.sleep(0)
+    asyncio.run(main())
+
+    dlabel = (("duty", "proposer"),)
+    assert reg._counters[("core_qbft_timeouts_total", dlabel)] >= 2
+    assert reg._counters[("core_qbft_round_changes_total", dlabel)] >= 2
+    key = ("core_qbft_round_duration_seconds", dlabel)
+    assert reg._hist[key].count >= 2
+    assert reg._gauges[("core_qbft_current_round", dlabel)] >= 3.0
+    [span] = [s for s in tr.spans if s.name.startswith("consensus/qbft/")]
+    assert span.end is not None and span.attrs["decided"] is False
+
+
+def test_qbft_justification_size_histogram():
+    """Round-change justifications carry quorums of messages; the size
+    histogram sees them once a round moves past 1."""
+    reg = Registry()
+    duty = Duty(11, DutyType.ATTESTER)
+
+    async def main():
+        net, nodes = build_cluster([reg] + [None] * (N - 1),
+                                   timeout_base=0.05)
+        decided = asyncio.Event()
+
+        async def on_decide(d, unsigned):
+            decided.set()
+
+        nodes[0].subscribe(on_decide)
+        # the round-1 leader (node 2 for this duty) stays silent: the
+        # cluster times out, round-changes, and round 2's PRE-PREPARE
+        # carries a quorum-of-ROUND-CHANGEs justification
+        assert duty_leader(duty, 1, N) == 2
+        for node in (nodes[0], nodes[1]):
+            await node.propose(duty, {"pk": "v"})
+        await asyncio.wait_for(decided.wait(), 10.0)
+        for node in nodes:
+            node.trim(duty)
+    asyncio.run(main())
+
+    key = ("core_qbft_justification_msgs", ())
+    assert key in reg._hist and reg._hist[key].count >= 1
+    # and the rounds moved: round-change counter fired on the way
+    assert reg._counters[
+        ("core_qbft_round_changes_total", (("duty", "attester"),))] >= 1
+
+
+def _psd(idx, sig=b"\x01" * 96):
+    return ParSignedData(data=SignedRandao(epoch=0, signature=sig),
+                         share_idx=idx)
+
+
+def test_mem_parsigex_counters_and_wire_bytes():
+    regs = [Registry(), Registry()]
+    duty = Duty(5, DutyType.RANDAO)
+
+    async def main():
+        net = MemParSigExNetwork()
+        a = net.join(registry=regs[0])
+        b = net.join(registry=regs[1])
+        got = []
+        b.subscribe(lambda d, p: got.append(p) or asyncio.sleep(0))
+        await a.broadcast(duty, {"pk": _psd(1)})
+        assert len(got) == 1
+    asyncio.run(main())
+
+    # sender side: outbound message + per-destination wire bytes
+    assert regs[0]._counters[
+        ("core_parsigex_outbound_total", (("duty", "randao"),))] == 1.0
+    sent = regs[0]._counters[
+        ("app_p2p_peer_sent_bytes_total", (("peer", "1"),))]
+    assert sent > 0
+    assert regs[0]._counters[
+        ("app_p2p_peer_sent_frames_total", (("peer", "1"),))] == 1.0
+    # receiver side: inbound message + per-sender wire bytes (symmetric)
+    assert regs[1]._counters[
+        ("core_parsigex_inbound_total", (("duty", "randao"),))] == 1.0
+    assert regs[1]._counters[
+        ("app_p2p_peer_recv_bytes_total", (("peer", "0"),))] == sent
+
+
+def test_equivocation_detector_counts_conflicting_sigs():
+    reg = Registry()
+    det = EquivocationDetector(reg)
+    duty = Duty(6, DutyType.ATTESTER)
+    assert det.check(duty, {"pk": _psd(2, b"\x01" * 96)}) == []
+    # same (duty, pk, share) and same sig: no equivocation
+    assert det.check(duty, {"pk": _psd(2, b"\x01" * 96)}) == []
+    # DIFFERENT sig: equivocation, counted per sender share
+    assert det.check(duty, {"pk": _psd(2, b"\x02" * 96)}) == [2]
+    assert det.equivocations == 1
+    assert reg._counters[
+        ("core_parsigex_equivocations_total", (("peer", "2"),))] == 1.0
+    # a different share is independent
+    assert det.check(duty, {"pk": _psd(3, b"\x03" * 96)}) == []
+
+
+def test_equivocation_detector_bounded_memory():
+    det = EquivocationDetector(max_duties=4)
+    for slot in range(16):
+        det.check(Duty(slot, DutyType.ATTESTER), {"pk": _psd(1)})
+    assert len(det._seen) == 4
+
+
+def test_tcpmesh_metric_helpers_need_no_crypto():
+    """The per-peer transport counters are pure registry arithmetic —
+    exercisable (and exercised) without the optional cryptography
+    dependency the channel security needs."""
+    from charon_tpu.p2p.transport import Peer, TCPMesh
+
+    reg = Registry()
+    peers = [Peer(0, "127.0.0.1", 1), Peer(1, "127.0.0.1", 2)]
+    mesh = TCPMesh(0, peers, node_identity=None, peer_pubkeys={},
+                   registry=reg)
+    mesh._count_sent(1, 100, 0.01)
+    mesh._count_sent(1, 50, 0.02)
+    mesh._count_recv(1, 42)
+    mesh.send_failures[1] = 3
+    mesh._count_send_result(1, ok=False)
+    mesh._count_handshake_failure("inbound")
+
+    peer1 = (("peer", "1"),)
+    assert reg._counters[("app_p2p_peer_sent_bytes_total", peer1)] == 150
+    assert reg._counters[("app_p2p_peer_sent_frames_total", peer1)] == 2
+    assert reg._counters[("app_p2p_peer_recv_bytes_total", peer1)] == 42
+    assert reg._counters[("app_p2p_peer_recv_frames_total", peer1)] == 1
+    assert reg._hist[("app_p2p_send_latency_seconds", peer1)].count == 2
+    assert reg._counters[("app_p2p_send_failures_total", peer1)] == 1
+    assert reg._gauges[("app_p2p_send_failure_streak", peer1)] == 3.0
+    assert reg._counters[("app_p2p_handshake_failures_total",
+                          (("peer", "inbound"),))] == 1
+    # a registry-less mesh is a no-op on every helper
+    quiet = TCPMesh(0, peers, node_identity=None, peer_pubkeys={})
+    quiet._count_sent(1, 1, 0.0)
+    quiet._count_send_result(1, ok=True)
+    quiet._count_handshake_failure("1")

@@ -12,9 +12,11 @@ import time
 
 import pytest
 
+from charon_tpu.app import log as applog
 from charon_tpu.app import otlp
 from charon_tpu.app.monitoring import (DEFAULT_BUCKETS, METRICS_CONTENT_TYPE,
-                                       MonitoringAPI, Registry)
+                                       READINESS_REASONS, MonitoringAPI,
+                                       Registry, set_readiness)
 from charon_tpu.app.tracing import Span, Tracer
 from charon_tpu.core.sigagg import SigAgg
 from charon_tpu.core.tracker import Step, Tracker
@@ -232,6 +234,151 @@ def test_sinks_from_env(tmp_path):
     assert otlp.sinks_from_env(environ={}) == []
     with pytest.raises(ValueError):
         otlp.AsyncHTTPSink("grpc://nope")
+
+
+# ---------------------------------------------------------------------------
+# LokiSink — bounded-queue batched log push (reference loki/client.go)
+# ---------------------------------------------------------------------------
+
+async def _start_capture_server(received):
+    async def handle(reader, writer):
+        await reader.readline()
+        clen = 0
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"content-length"):
+                clen = int(line.split(b":")[1])
+        received.append(json.loads(await reader.readexactly(clen)))
+        writer.write(b"HTTP/1.0 204 No Content\r\nContent-Length: 0\r\n\r\n")
+        await writer.drain()
+        writer.close()
+
+    return await asyncio.start_server(handle, "127.0.0.1", 0)
+
+
+def test_loki_sink_batches_valid_push_documents():
+    async def main():
+        received = []
+        server = await _start_capture_server(received)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            sink = applog.LokiSink(
+                f"http://127.0.0.1:{port}/loki/api/v1/push",
+                labels={"node": "node0", "cluster": "t"},
+                flush_interval=0.05)
+            for i in range(3):
+                sink({"ts": 1000.0 + i, "level": "info",
+                      "topic": "bcast", "msg": f"m{i}"})
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if sink.exported == 3:
+                    break
+            assert sink.exported == 3 and sink.dropped == 0
+            [doc] = received
+            [stream] = doc["streams"]
+            assert stream["stream"] == {"node": "node0", "cluster": "t"}
+            assert len(stream["values"]) == 3
+            # values are [ns-timestamp-string, json line] pairs
+            ns, line = stream["values"][0]
+            assert ns == str(int(1000.0 * 1e9))
+            assert json.loads(line)["msg"] == "m0"
+            await sink.aclose()
+        finally:
+            server.close()
+    asyncio.run(main())
+
+
+def test_loki_sink_bounded_queue_counts_drops():
+    async def main():
+        reg = Registry()
+        sink = applog.LokiSink("http://127.0.0.1:9/loki/api/v1/push",
+                               registry=reg, max_queue=2,
+                               flush_interval=60.0)
+        for i in range(5):
+            sink({"ts": float(i), "msg": f"m{i}"})
+        assert sink.dropped == 3 and len(sink._queue) == 2
+        assert reg._counters[("app_loki_dropped_records_total", ())] == 3
+        await sink.aclose()  # endpoint down: counted, not raised
+        assert sink.send_failures >= 1 and sink.exported == 0
+    asyncio.run(main())
+
+
+def test_loki_endpoint_down_never_raises_into_logging():
+    """A dead Loki is a telemetry loss, never a logging failure: emitting
+    through the standard log helpers with the sink installed must not
+    raise, and the failure lands in send_failures only."""
+    async def main():
+        sink = applog.LokiSink("http://127.0.0.1:9/loki/api/v1/push",
+                               flush_interval=0.02)
+        applog.add_sink(sink)
+        try:
+            applog.init(format="json", level="info")
+            applog.info("bcast", "duty broadcast", slot=12)
+            applog.warn("bcast", "duty late", slot=13)
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if sink.send_failures:
+                    break
+            assert sink.send_failures >= 1
+        finally:
+            applog.remove_sink(sink)
+            await sink.aclose()
+        assert sink not in applog._sinks
+    asyncio.run(main())
+
+
+def test_loki_sink_from_env_node_expansion():
+    sink = applog.loki_sink_from_env(
+        node_name="node2",
+        environ={"CHARON_TPU_LOKI_ENDPOINT":
+                 "http://loki.{node}.svc:3100/loki/api/v1/push",
+                 "CHARON_TPU_LOKI_QUEUE": "9"})
+    assert sink is not None
+    assert sink._host == "loki.node2.svc"
+    assert sink._max_queue == 9
+    assert sink._labels["node"] == "node2"
+    assert applog.loki_sink_from_env(environ={}) is None
+    with pytest.raises(ValueError):
+        applog.LokiSink("grpc://nope")
+
+
+# ---------------------------------------------------------------------------
+# Readiness enum gauge + /readyz reason body
+# ---------------------------------------------------------------------------
+
+def test_readiness_enum_gauge_one_hot():
+    reg = Registry()
+    set_readiness(reg, "mesh_degraded")
+    text = reg.render()
+    assert_prometheus_valid(text)
+    assert 'app_readiness{reason="mesh_degraded"} 1.0' in text
+    for r in READINESS_REASONS:
+        if r != "mesh_degraded":
+            assert f'app_readiness{{reason="{r}"}} 0.0' in text
+    set_readiness(reg, "ok")
+    text = reg.render()
+    assert 'app_readiness{reason="ok"} 1.0' in text
+    assert 'app_readiness{reason="mesh_degraded"} 0.0' in text
+
+
+def test_readyz_body_carries_reason():
+    async def main():
+        state = {"ok": True, "reason": "ok"}
+        api = MonitoringAPI(Registry(),
+                            readyz=lambda: (state["ok"], state["reason"]))
+        await api.start()
+        try:
+            status, _, body = await _fetch(api.port, "/readyz")
+            assert status == "200 OK" and body == b"ok"
+            state.update(ok=False, reason="only 1/3 quorum peers reachable")
+            status, _, body = await _fetch(api.port, "/readyz")
+            assert status.startswith("503")
+            assert b"quorum peers reachable" in body
+        finally:
+            await api.stop()
+    asyncio.run(main())
 
 
 # ---------------------------------------------------------------------------

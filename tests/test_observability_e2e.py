@@ -3,14 +3,19 @@ observability layer.
 
 A full in-memory simnet cluster (4 nodes, t=3) with the complete
 observability stack wired per node: monitoring Registry + MonitoringAPI
-over real HTTP, duty Tracer with an OTLP/JSON file sink per node, and a
-Tracker + Deadliner GC exporting per-peer participation and inclusion
-delay.  Asserts:
+over real HTTP, duty Tracer with an OTLP/JSON file sink per node,
+real QBFT consensus (instrumented: round metrics + instance spans), an
+instrumented in-memory parsigex (per-peer wire-byte counters through the
+real codec), a slot-budget accountant, and a Tracker + Deadliner GC
+exporting per-peer participation and inclusion delay.  Asserts:
 
 - every node exports OTLP JSON, and one duty's spans join into a single
-  cross-node trace (identical 128-bit trace IDs in the export files);
-- /metrics serves per-peer participation and inclusion-delay histograms
-  in valid Prometheus text format (0.0.4 content type);
+  cross-node trace (identical 128-bit trace IDs in the export files),
+  with the duty's consensus/qbft spans and sigagg spans in the SAME
+  trace on every node;
+- /metrics serves per-peer participation, inclusion-delay histograms,
+  and the qbft / transport / slot-phase families in valid Prometheus
+  text format (0.0.4 content type);
 - /debug/profile returns a non-empty jax profiler capture on CPU;
 - /debug/spans round-trips through the OTLP JSON parser.
 
@@ -34,8 +39,9 @@ from charon_tpu.app.monitoring import (METRICS_CONTENT_TYPE, MonitoringAPI,
                                        Registry)
 from charon_tpu.app.node import Node, NodeConfig
 from charon_tpu.app.tracing import Tracer, duty_trace_id
-from charon_tpu.core.leadercast import LeaderCast, MemTransportNetwork
+from charon_tpu.core.consensus import ConsensusMemNetwork, QBFTConsensus
 from charon_tpu.core.parsigex import MemParSigExNetwork
+from charon_tpu.core.types import DutyType
 from charon_tpu.tbls import api as tbls
 from charon_tpu.testutil.beaconmock import BeaconMock
 from charon_tpu.testutil.cluster import new_cluster_for_test
@@ -66,7 +72,7 @@ def build_observable_cluster(tmp_path):
     pubshares_by_peer = {
         idx: cluster.pubshare_map(idx) for idx in range(1, N_NODES + 1)}
     psx_net = MemParSigExNetwork()
-    lc_net = MemTransportNetwork()
+    qbft_net = ConsensusMemNetwork()
 
     nodes, sinks = [], []
     for idx in range(1, N_NODES + 1):
@@ -82,9 +88,16 @@ def build_observable_cluster(tmp_path):
         cfg = NodeConfig(share_idx=idx, threshold=THRESHOLD,
                          pubshares_by_peer=pubshares_by_peer,
                          fork_version=FORK)
+        # real QBFT with the full consensus-telemetry wiring: round
+        # metrics + a consensus/qbft/{slot} span per instance joining
+        # the duty's deterministic trace
+        consensus = QBFTConsensus(qbft_net, idx - 1, N_NODES,
+                                  round_timeout_base=0.3,
+                                  registry=registry, tracer=tracer,
+                                  trace_id_fn=duty_trace_id)
         node = Node(cfg, bmock,
-                    consensus=LeaderCast(lc_net, idx - 1, N_NODES),
-                    parsigex=psx_net.join(),
+                    consensus=consensus,
+                    parsigex=psx_net.join(registry=registry),
                     slots_per_epoch=SPE, genesis_time=bmock.genesis,
                     slot_duration=SLOT_DUR,
                     registry=registry, tracer=tracer)
@@ -118,15 +131,18 @@ def test_observability_e2e_4_nodes(tmp_path):
             # run until every node's tracker analysed a successful duty
             # (deadline = slot + 5 slots, so ~2.5 s wall-clock minimum)
             deadline = time.time() + 8 * SPE * SLOT_DUR + 10.0
+
+            def _ok_attester(n):
+                return any(r.success and r.duty.type == DutyType.ATTESTER
+                           for r in n.tracker.reports)
+
             while time.time() < deadline:
                 await asyncio.sleep(0.1)
-                if bmock.attestations and all(
-                        any(r.success for r in n.tracker.reports)
-                        for n in nodes):
+                if bmock.attestations and all(map(_ok_attester, nodes)):
                     break
             assert bmock.attestations, "no attestations broadcast"
-            assert all(any(r.success for r in n.tracker.reports)
-                       for n in nodes), "a node never analysed a success"
+            assert all(map(_ok_attester, nodes)), \
+                "a node never analysed a successful attester duty"
 
             # --- /metrics: per-peer participation + inclusion delay in
             #     valid Prometheus text format, correct content type ---
@@ -149,6 +165,27 @@ def test_observability_e2e_4_nodes(tmp_path):
                 # TPU-boundary launches surfaced as spans feed the
                 # span-duration histogram too
                 assert "app_span_duration_seconds" in text
+                # consensus telemetry: QBFT round histograms + decided
+                # counters per duty type, current-round/leader gauges
+                assert "core_qbft_round_duration_seconds_bucket" in text
+                assert re.search(
+                    r'core_qbft_decided_total\{duty="attester",'
+                    r'node="node\d+"\} ', text)
+                assert 'core_qbft_current_round{duty=' in text
+                assert re.search(r'core_qbft_leader\{duty="\w+",'
+                                 r'node="node\d+",peer="\d+"\} ', text)
+                # transport family (in-memory parsigex counts real wire
+                # bytes per destination peer, like the TCP mesh)
+                assert re.search(
+                    r'app_p2p_peer_sent_bytes_total\{node="node\d+",'
+                    r'peer="\d+"\} [1-9]', text)
+                assert "core_parsigex_inbound_total" in text
+                # slot-budget decomposition: at least the consensus and
+                # parsig-ex phases were attributed for analysed duties
+                assert 'core_slot_phase_seconds_bucket{' in text
+                assert 'phase="consensus"' in text
+                assert 'phase="parsig_ex"' in text
+                assert "core_slot_budget_remaining_seconds" in text
 
             # --- inclusion delay measured inside the duty window ---
             n0 = nodes[0]
@@ -160,7 +197,8 @@ def test_observability_e2e_4_nodes(tmp_path):
 
             # --- cross-node trace join: one duty, one trace ID, spans
             #     from ALL nodes in the OTLP exports ---
-            ok_duty = next(r.duty for r in n0.tracker.reports if r.success)
+            ok_duty = next(r.duty for r in n0.tracker.reports
+                           if r.success and r.duty.type == DutyType.ATTESTER)
             tid = duty_trace_id(ok_duty)
             in_memory = sum(1 for n in nodes if n.tracer.trace(tid))
             assert in_memory >= 2, "duty trace did not join across tracers"
@@ -182,14 +220,35 @@ def test_observability_e2e_4_nodes(tmp_path):
             # --- TPU-boundary spans rode the same export (batch verify
             #     + threshold combine launch spans) ---
             all_spans = []
+            per_node_spans = []
             for idx in range(N_NODES):
                 with open(tmp_path / f"node{idx}.otlp.jsonl") as f:
-                    all_spans.extend(otlp.parse_export_lines(f.read()))
+                    spans = otlp.parse_export_lines(f.read())
+                per_node_spans.append(spans)
+                all_spans.extend(spans)
             combine = [s for s in all_spans
                        if s.name == "tpu/threshold_combine"]
             assert combine, "no threshold_combine spans exported"
             assert all(s.attrs["path"] == "insecure-test" for s in combine)
             assert any(s.attrs["batch"] >= 1 for s in combine)
+
+            # --- consensus spans join the duty trace: on EVERY node the
+            #     duty's QBFT instance span and its sigagg edge span
+            #     carry the same deterministic trace ID ---
+            for idx, spans in enumerate(per_node_spans):
+                qbft_spans = [s for s in spans
+                              if s.name.startswith("consensus/qbft/")
+                              and s.trace_id == tid]
+                assert qbft_spans, f"node{idx}: no QBFT span in duty trace"
+                assert all(s.end is not None for s in qbft_spans)
+                qspan = qbft_spans[0]
+                assert qspan.attrs["decided"] is True
+                assert qspan.attrs["rounds"] >= 1
+                sigagg_spans = [s for s in spans
+                                if s.name == "core/sigagg_aggregate"
+                                and s.trace_id == tid]
+                assert sigagg_spans, \
+                    f"node{idx}: no sigagg span in duty trace"
 
             # --- /debug/spans round-trips through the OTLP parser ---
             status, headers, body = await asyncio.to_thread(

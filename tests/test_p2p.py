@@ -41,17 +41,22 @@ def free_ports(n: int) -> list[int]:
     return ports
 
 
-def make_mesh(n: int):
+def make_mesh(n: int, registries=None):
     ports = free_ports(n)
     peers = [Peer(i, "127.0.0.1", ports[i]) for i in range(n)]
     ids, pubs = new_test_identities(n)
-    return [TCPMesh(i, peers, ids[i], pubs, cluster_hash=b"test")
+    return [TCPMesh(i, peers, ids[i], pubs, cluster_hash=b"test",
+                    registry=registries[i] if registries else None)
             for i in range(n)]
 
 
 def test_send_receive_roundtrip():
+    from charon_tpu.app.monitoring import Registry
+
+    regs = [Registry(), Registry()]
+
     async def main():
-        meshes = make_mesh(2)
+        meshes = make_mesh(2, registries=regs)
         for m in meshes:
             await m.start()
         try:
@@ -68,6 +73,24 @@ def test_send_receive_roundtrip():
             for m in meshes:
                 await m.stop()
     asyncio.run(main())
+
+    # per-peer transport metrics rode the exchange: node0 sent 2 frames
+    # to peer 1 (echo + ping) and got 2 replies back; byte counters and
+    # the send-latency histogram populate alongside
+    sent = regs[0]._counters[
+        ("app_p2p_peer_sent_frames_total", (("peer", "1"),))]
+    assert sent == 2.0
+    assert regs[0]._counters[
+        ("app_p2p_peer_sent_bytes_total", (("peer", "1"),))] > 0
+    assert regs[0]._counters[
+        ("app_p2p_peer_recv_frames_total", (("peer", "1"),))] == 2.0
+    lat_key = ("app_p2p_send_latency_seconds", (("peer", "1"),))
+    assert regs[0]._hist[lat_key].count == 2
+    # responder side mirrors it under peer=0 (2 inbound, 2 replies)
+    assert regs[1]._counters[
+        ("app_p2p_peer_recv_frames_total", (("peer", "0"),))] == 2.0
+    assert regs[1]._counters[
+        ("app_p2p_peer_sent_frames_total", (("peer", "0"),))] == 2.0
 
 
 def test_unknown_identity_rejected():

@@ -1,0 +1,153 @@
+"""Slot-budget accountant unit tests — fake-clock phase attribution,
+the budget-remaining gauge, and the late-duty watchdog's responsible-
+phase selection (completed-but-late vs never-completed duties)."""
+
+import asyncio
+
+from charon_tpu.app.monitoring import Registry
+from charon_tpu.core.slotbudget import PHASES, SlotBudget, expected_phases
+from charon_tpu.core.types import Duty, DutyType
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make(clock, budget=12.0, registry=None):
+    return SlotBudget(registry=registry,
+                      slot_start_fn=lambda slot: 0.0,
+                      budget_seconds=budget, clock=clock)
+
+
+def drive(sb, clock, duty, marks):
+    """Feed the hand-off hooks at the given fake times."""
+    async def main():
+        hooks = {
+            "scheduler": lambda: sb.on_duty_scheduled(duty, {}),
+            "fetcher": lambda: sb.on_fetched(duty, {}),
+            "consensus": lambda: sb.on_consensus(duty, {}),
+            "parsig_ex": lambda: sb.on_threshold(duty, "pk", []),
+            "sigagg": lambda: sb.on_aggregated(duty, "pk", None),
+            "bcast": lambda: sb.on_broadcast(duty, "pk", None),
+        }
+        for phase, at in marks:
+            clock.t = at
+            await hooks[phase]()
+    asyncio.run(main())
+
+
+def test_phase_attribution_exact_deltas():
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, registry=reg)
+    duty = Duty(0, DutyType.ATTESTER)
+    drive(sb, clock, duty, [
+        ("scheduler", 1.0), ("fetcher", 1.5), ("consensus", 3.0),
+        ("parsig_ex", 3.25), ("sigagg", 3.75), ("bcast", 4.0)])
+    phases = sb.finalize(duty)
+    assert phases == {"scheduler": 1.0, "fetcher": 0.5, "consensus": 1.5,
+                      "parsig_ex": 0.25, "sigagg": 0.5, "bcast": 0.25}
+    # each phase landed in the histogram with its own label
+    for phase in PHASES:
+        key = ("core_slot_phase_seconds", (("phase", phase),))
+        assert reg._hist[key].count == 1
+        assert abs(reg._hist[key].sum - phases[phase]) < 1e-9
+    assert sb.late_duties == 0
+    # finalize pops the state: a second call is a no-op
+    assert sb.finalize(duty) is None
+
+
+def test_budget_remaining_gauge_at_bcast():
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, budget=12.0, registry=reg)
+    duty = Duty(0, DutyType.ATTESTER)
+    drive(sb, clock, duty, [("scheduler", 1.0), ("bcast", 4.5)])
+    assert reg._gauges[("core_slot_budget_remaining_seconds", ())] == 7.5
+
+
+def test_completed_but_late_blames_costliest_phase():
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, budget=2.0, registry=reg)
+    duty = Duty(0, DutyType.ATTESTER)
+    drive(sb, clock, duty, [
+        ("scheduler", 0.1), ("fetcher", 0.2), ("consensus", 2.7),
+        ("parsig_ex", 2.8), ("sigagg", 2.9), ("bcast", 3.0)])
+    sb.finalize(duty)
+    assert sb.late_duties == 1
+    key = ("core_slot_late_duties_total", (("phase", "consensus"),))
+    assert reg._counters[key] == 1.0
+
+
+def test_incomplete_duty_blames_first_missing_phase():
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, budget=12.0, registry=reg)
+    duty = Duty(0, DutyType.ATTESTER)
+    # consensus never completed: scheduled + fetched only
+    drive(sb, clock, duty, [("scheduler", 0.1), ("fetcher", 0.3)])
+    sb.finalize(duty)
+    assert sb.late_duties == 1
+    key = ("core_slot_late_duties_total", (("phase", "consensus"),))
+    assert reg._counters[key] == 1.0
+
+
+def test_no_bcast_duty_completes_at_sigagg():
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, budget=12.0, registry=reg)
+    duty = Duty(0, DutyType.RANDAO)  # internal-only: never broadcast
+    drive(sb, clock, duty, [("parsig_ex", 0.4), ("sigagg", 0.6)])
+    sb.finalize(duty)
+    assert sb.late_duties == 0
+
+
+def test_expected_phases_per_duty_type():
+    assert expected_phases(DutyType.ATTESTER) == PHASES
+    assert expected_phases(DutyType.RANDAO) == ("parsig_ex", "sigagg")
+    assert expected_phases(DutyType.EXIT) == ("parsig_ex", "sigagg", "bcast")
+
+
+def test_out_of_order_events_clamp_to_zero():
+    """Subscriber ordering skew must never produce negative phase costs."""
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, registry=reg)
+    duty = Duty(0, DutyType.ATTESTER)
+    drive(sb, clock, duty, [
+        ("scheduler", 1.0), ("fetcher", 0.9),  # skewed backwards
+        ("consensus", 1.2), ("parsig_ex", 1.3), ("sigagg", 1.4),
+        ("bcast", 1.5)])
+    phases = sb.finalize(duty)
+    assert phases["fetcher"] == 0.0
+    assert all(v >= 0 for v in phases.values())
+    assert sb.late_duties == 0
+
+
+def test_tracker_report_drives_finalize():
+    from charon_tpu.core.tracker import DutyReport
+
+    clock = FakeClock()
+    reg = Registry()
+    sb = make(clock, registry=reg)
+    duty = Duty(3, DutyType.ATTESTER)
+    drive(sb, clock, duty, [("scheduler", 0.1)])
+    asyncio.run(sb.on_report(DutyReport(duty=duty, success=False)))
+    assert duty not in sb._events
+    assert sb.late_duties == 1
+
+
+def test_bounded_duty_memory():
+    clock = FakeClock()
+    sb = make(clock)
+    sb._max = 8
+    async def main():
+        for slot in range(32):
+            await sb.on_duty_scheduled(Duty(slot, DutyType.ATTESTER), {})
+    asyncio.run(main())
+    assert len(sb._events) == 8

@@ -119,6 +119,83 @@ reg.inc(computed_name)
     assert not report.ok
 
 
+def test_bucket_lint_flags_non_monotone_and_inf():
+    """set_buckets literals must be strictly-increasing finite numbers
+    (the renderer appends +Inf itself)."""
+    from charon_tpu.analysis.metrics_lint import lint_sources
+
+    bad = """
+reg.set_buckets("app_a_seconds", (0.1, 0.05, 1.0))
+reg.set_buckets("app_b_seconds", (0.5, 0.5))
+reg.set_buckets("app_c_seconds", (0.1, float("inf")))
+reg.set_buckets("app_d_seconds", ())
+"""
+    report = lint_sources({"charon_tpu/fake.py": bad})
+    text = "\n".join(report.violations)
+    assert text.count("not strictly increasing") == 2
+    assert "finite numeric literal" in text
+    assert "empty bucket ladder" in text
+    assert not report.ok
+
+    good = """
+reg.set_buckets("app_a_seconds", (0.1, 0.25, 1.0, 10.0))
+reg.set_buckets("app_b_msgs", (1, 2, 4, 8))
+reg.set_buckets("app_c_seconds", computed_bounds)
+"""
+    assert lint_sources({"charon_tpu/fake.py": good}).ok
+
+
+def test_label_cardinality_guard():
+    """Guarded label keys (reason/peer/step/path/...) reject interpolated
+    values — the unbounded-series factory — while enum-style values
+    (literals, names, attributes, .name/.lower chains, str(index)) pass."""
+    from charon_tpu.analysis.metrics_lint import lint_sources
+
+    bad = """
+reg.inc("app_e_total", labels={"reason": f"err {e}"})
+reg.inc("app_f_total", labels={"peer": host + ":" + str(port)})
+reg.inc("app_g_total", labels={"path": "{}".format(x)})
+reg.inc("app_h_total", labels={"step": repr(step)})
+reg.inc("app_i_total", labels={"reason": str(exc.args[0])})
+"""
+    report = lint_sources({"charon_tpu/fake.py": bad})
+    assert len([v for v in report.violations
+                if "guarded labels" in v]) == 5
+
+    good = """
+reg.inc("app_e_total", labels={"reason": "bn_down"})
+reg.inc("app_f_total", labels={"peer": str(idx)})
+reg.inc("app_g_total", labels={"step": report.failed_step.name.lower()})
+reg.inc("app_h_total", labels={"duty": duty.type.name.lower()})
+reg.inc("app_i_total", labels={"phase": phase})
+reg.inc("app_j_total", labels={"free_text": f"unguarded {x} is fine"})
+"""
+    assert lint_sources({"charon_tpu/fake.py": good}).ok
+
+
+def test_golden_bad_lint_fixtures_flagged():
+    from charon_tpu.analysis.fixtures import audit_golden_bad
+
+    for which, needle in (("bad_buckets", "strictly increasing"),
+                          ("unbounded_label", "guarded labels")):
+        report = audit_golden_bad(which)
+        assert not report.ok
+        assert needle in "\n".join(report.violations)
+        assert "FAIL" in report.summary()
+
+
+def test_cli_golden_bad_lint_exits_nonzero():
+    """The lint golden-bads ride the same CLI contract as the kernel
+    fixtures: `--golden-bad unbounded_label` exits 1 (and is cheap — no
+    kernel tracing)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "charon_tpu.analysis",
+         "--golden-bad", "unbounded_label"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL" in proc.stdout
+
+
 def test_metric_name_lint_cli_flag():
     """`--no-metrics-lint` is accepted and the default full-audit CLI
     path includes the lint (wired into __main__)."""
