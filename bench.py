@@ -117,6 +117,20 @@ def main() -> None:
     api.set_scheme("bls")
     api.set_backend("tpu")
 
+    # ---- startup shape prewarm (round 10) ---------------------------------
+    # Compile the production programs at this bench's (V, T) buckets BEFORE
+    # any other device work, exactly like `app/run`'s boot hook — the
+    # first-duty timings below then show whether the first full-shape
+    # verify/combine call after "boot" still pays a cold-compile spike.
+    from charon_tpu.tbls import dispatch as tdispatch
+
+    prewarm = None
+    if tdispatch.prewarm_enabled():
+        t0 = time.perf_counter()
+        prewarm = api.prewarm([], V, T)
+        prewarm["wall_s"] = round(time.perf_counter() - t0, 3)
+        print(f"prewarm: {prewarm}", file=sys.stderr)
+
     msg = b"bench-attestation-data-root"
     hm = hash_to_g2(msg)
     hm_packed = jcurve.g2_pack([hm])[0]
@@ -181,7 +195,12 @@ def main() -> None:
     assert got == small_expected, "combine != sk·H(m) on real Shamir shares"
 
     # ---- timed reps -------------------------------------------------------
+    # the FIRST full-shape combine after "boot": with prewarm on this is
+    # steady-state latency, without it it eats the cold XLA compile — the
+    # first-duty-latency witness of the acceptance criteria
+    t0 = time.perf_counter()
     api.threshold_combine(fresh_batch())            # compile + warmup
+    first_combine_ms = round((time.perf_counter() - t0) * 1e3, 3)
 
     times = []
     for rep in range(REPS):
@@ -225,7 +244,10 @@ def main() -> None:
         return out
 
     entries = verify_entries_for(VV)
-    assert all(api.batch_verify(entries))           # compile + warmup + check
+    t0 = time.perf_counter()
+    ok = api.batch_verify(entries)                  # compile + warmup + check
+    first_verify_ms = round((time.perf_counter() - t0) * 1e3, 3)
+    assert all(ok)
     # honesty: a corrupted signature inside an otherwise-valid batch must
     # still be rejected through the RLC batch check + per-row recheck
     bad = list(entries)
@@ -255,6 +277,11 @@ def main() -> None:
         # the device hash-to-G2 path (ops/pallas_h2c, CHARON_TPU_H2C)
         # takes off the host
         configs += _run_cold_cache_configs(api, rng, REPS)
+        # round 10: pipelined (off-loop, double-buffered, tiled) vs
+        # inline dispatch of the same verify+combine work at the same
+        # kernel shapes — overlap efficiency = device-busy / wall
+        configs += _run_pipeline_ab_configs(
+            api, rng, pool_bytes, verify_entries_for, REPS)
 
     result = {
         "metric": "sigagg_latency_p99_ms",
@@ -276,6 +303,15 @@ def main() -> None:
         "verify_vs_r04": round(verify_sigs_per_s / 1976, 2),
         "verify_path": backend_tpu.pairing_path(VV),
         "h2c_path": backend_tpu.h2c_path(),
+        "dispatch": {
+            "enabled": tdispatch.dispatch_enabled(),
+            "tile": tdispatch.verify_tile_size(),
+            "prewarm": prewarm,
+            # no cold-compile spike ⇔ these sit at steady-state latency
+            # when prewarm is on (compare rep_times_ms / verify_ms)
+            "first_duty_combine_ms": first_combine_ms,
+            "first_duty_verify_ms": first_verify_ms,
+        },
         "configs": configs,
         "oracle_checked": True,
         "platform": jax.devices()[0].platform,
@@ -286,7 +322,7 @@ def main() -> None:
     out = json.dumps(result)
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r07.json")
+                            "BENCH_r10.json")
         with open(path, "w") as fh:
             fh.write(out + "\n")
     except OSError:
@@ -494,6 +530,96 @@ def _run_cold_cache_configs(api, rng, reps: int, n4: int = 2048,
         lambda: keygen.verify_share_proofs_multi(items5),
         lambda: keygen.verify_share_proofs_multi(bad5)))
     return out
+
+
+def _run_pipeline_ab_configs(api, rng, pool_bytes, verify_entries_for,
+                             reps: int) -> list:
+    """Pipelined-vs-inline A/B (round 10): the same duty work — verify
+    tiles at the headline 2048-entry bucket plus a 2000×7 combine — runs
+    (a) INLINE, sequentially on the calling thread (the seed behaviour),
+    and (b) PIPELINED through `tbls.dispatch.DispatchPipeline` (host
+    prep double-buffered against device launches, verify tiled into
+    pipelined sub-launches).  Kernel shapes are identical in both arms,
+    so the delta is pure overlap.  Honesty: within a rep both arms
+    consume the SAME inputs and their output bytes/verdicts must match
+    bit-exactly; overlap efficiency = launch-stage busy time / pipelined
+    wall time."""
+    import asyncio
+    import time
+
+    from charon_tpu.tbls import dispatch as tdispatch
+
+    TILE = 2048
+    POOL = pool_bytes.shape[0]
+    idxs = tuple(range(1, 8))   # T = 7, matching selection-proofs-2k
+
+    def combine_batch(rows):
+        pick = rng.integers(0, POOL, (rows, len(idxs)))
+        raw = pool_bytes[pick]
+        return [{i: raw[v, k].tobytes() for k, i in enumerate(idxs)}
+                for v in range(rows)]
+
+    entries = verify_entries_for(TILE)
+
+    def run_ab(name, n_tiles, combine_rows):
+        flat = entries * n_tiles
+        pipe = tdispatch.DispatchPipeline(tile=TILE)
+
+        def inline_arm(batch):
+            oks = []
+            for k in range(n_tiles):
+                oks += api.batch_verify(entries)
+            out = api.threshold_combine(batch) if combine_rows else []
+            return oks, out
+
+        async def pipelined_arm(batch):
+            jobs = [pipe.batch_verify(flat)]
+            if combine_rows:
+                jobs.append(pipe.threshold_combine(batch))
+            res = await asyncio.gather(*jobs)
+            return res[0], (res[1] if combine_rows else [])
+
+        # warmup both arms (shapes already compiled by the main sections)
+        wb = combine_batch(combine_rows) if combine_rows else []
+        inline_arm(wb)
+        asyncio.run(pipelined_arm(wb))
+        inline_times, pipe_times = [], []
+        busy0 = pipe.device_busy_s
+        for _ in range(reps):
+            batch = combine_batch(combine_rows) if combine_rows else []
+            t0 = time.perf_counter()
+            oks_i, out_i = inline_arm(batch)
+            inline_times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            oks_p, out_p = asyncio.run(pipelined_arm(batch))
+            pipe_times.append(time.perf_counter() - t0)
+            assert all(oks_p) and oks_p == oks_i, \
+                f"{name}: pipelined verdicts diverge from inline"
+            assert out_p == out_i, \
+                f"{name}: pipelined combine bytes diverge from inline"
+        busy = pipe.device_busy_s - busy0
+        pipe.shutdown()
+        p50_i = sorted(inline_times)[len(inline_times) // 2]
+        p50_p = sorted(pipe_times)[len(pipe_times) // 2]
+        return {
+            "config": name, "reps": reps, "tiles": n_tiles,
+            "verify_entries": len(flat), "V": combine_rows, "T": 7,
+            "rep_times_ms": [round(t * 1e3, 3) for t in pipe_times],
+            "inline_rep_times_ms": [round(t * 1e3, 3)
+                                    for t in inline_times],
+            "pipelined_p50_ms": round(p50_p * 1e3, 3),
+            "inline_p50_ms": round(p50_i * 1e3, 3),
+            "speedup_vs_inline": round(p50_i / p50_p, 4),
+            "overlap_efficiency": round(busy / max(sum(pipe_times), 1e-9),
+                                        4),
+        }
+
+    return [
+        # verify-only: prep of tile k+1 overlaps device of tile k
+        run_ab("pipeline-ab-verify-4x2048", 4, 0),
+        # mixed duty tick: verify tile + the full combine overlap
+        run_ab("pipeline-ab-verify2048+combine2000", 1, 2000),
+    ]
 
 
 def _dkg_share_verify_workload(rng):

@@ -168,6 +168,35 @@ def set_readiness(registry: "Registry", reason: str) -> None:
     if reason not in READINESS_REASONS:
         registry.set_gauge("app_readiness", 1.0, labels={"reason": reason})
 
+#: Loop-lag probe buckets: the 12 s slot budget makes 1 ms–1 s the band
+#: that matters; the alerting threshold (p99 < 50 ms, the dispatch
+#: pipeline's acceptance bar) needs resolution around 10–100 ms.
+LOOP_LAG_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5)
+
+
+async def loop_lag_probe(registry: "Registry", interval: float = 0.05,
+                         dispatcher=None) -> None:
+    """Self-timing event-loop health probe: sleep `interval`, measure how
+    late the wake-up lands, and export the excess as the
+    ``app_event_loop_lag_seconds`` histogram — the before/after witness
+    for the off-loop dispatch pipeline (an inline multi-hundred-ms device
+    launch shows up here as a multi-hundred-ms lag sample).  When a
+    `tbls.dispatch.DispatchPipeline` is passed, its launch backlog is
+    exported as the ``app_dispatch_queue_depth`` gauge on every tick.
+    Runs until cancelled."""
+    registry.set_buckets("app_event_loop_lag_seconds", LOOP_LAG_BUCKETS)
+    loop = asyncio.get_running_loop()
+    while True:
+        t0 = loop.time()
+        await asyncio.sleep(interval)
+        lag = max(0.0, loop.time() - t0 - interval)
+        registry.observe("app_event_loop_lag_seconds", lag)
+        if dispatcher is not None:
+            registry.set_gauge("app_dispatch_queue_depth",
+                               dispatcher.queue_depth)
+
+
 PROFILE_MAX_SECONDS = 30.0
 
 #: jax.profiler trace state is PROCESS-global, so the in-flight guard

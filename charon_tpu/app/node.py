@@ -27,6 +27,8 @@ from ..core.types import Duty, ParSignedDataSet, PubKey
 from ..core.validatorapi import ValidatorAPI
 from ..core.verify import BatchVerifier
 from ..eth2util.signing import signing_root
+from ..tbls import dispatch
+from .monitoring import loop_lag_probe
 from .tracing import Tracer, with_tracing
 
 
@@ -62,11 +64,15 @@ class Node:
         self.fetcher = Fetcher(eth2cl)
         self.consensus = consensus
         self.dutydb = MemDutyDB()
+        # Off-loop dispatch pipeline shared by verify + combine launches
+        # (None when CHARON_TPU_DISPATCH=0 pins legacy inline launches).
+        self.dispatcher = dispatch.default_pipeline()
         # Both verify call-sites (local VC submissions + inbound peer
         # partials) share one micro-batching verifier → one
         # tbls.batch_verify launch per event-loop tick (reference per-sig
         # call-sites: validatorapi.go:1052-1068, parsigex.go:152-176).
-        self.verifier = BatchVerifier(tracer=self.tracer)
+        self.verifier = BatchVerifier(tracer=self.tracer,
+                                      dispatcher=self.dispatcher)
         self.vapi = ValidatorAPI(
             share_idx=cfg.share_idx,
             pubshare_by_group=pubshares,
@@ -80,7 +86,8 @@ class Node:
         # declare the hook but have none set.
         if getattr(parsigex, "_verify_fn", True) is None:
             parsigex._verify_fn = self._verify_external
-        self.sigagg = SigAgg(cfg.threshold, tracer=self.tracer)
+        self.sigagg = SigAgg(cfg.threshold, tracer=self.tracer,
+                             dispatcher=self.dispatcher)
         self.aggsigdb = MemAggSigDB()
         self.bcast = Broadcaster(eth2cl, genesis_time, slot_duration,
                                  registry=registry)
@@ -146,6 +153,7 @@ class Node:
 
         self._run_task: asyncio.Task | None = None
         self._gc_task: asyncio.Task | None = None
+        self._lag_task: asyncio.Task | None = None
 
     async def _verify_external(self, duty: Duty,
                                pset: ParSignedDataSet) -> None:
@@ -180,8 +188,18 @@ class Node:
             await self.tracker.analyse(duty)
 
     def start(self) -> None:
-        loop = asyncio.get_event_loop()
+        # get_running_loop: start() is always called from inside the
+        # node's event loop, and get_event_loop would silently bind a
+        # fresh never-run loop when that ever stops being true
+        loop = asyncio.get_running_loop()
         self._run_task = loop.create_task(self.scheduler.run())
+        if self.registry is not None:
+            # event-loop health: the simnet node exports the same
+            # app_event_loop_lag_seconds / dispatch queue-depth families
+            # as the full App, so loop-responsiveness tests run without
+            # the TCP/crypto stack
+            self._lag_task = loop.create_task(
+                loop_lag_probe(self.registry, dispatcher=self.dispatcher))
         if self.tracker is not None:
             self.deadliner = Deadliner(
                 lambda d: duty_deadline(d, self._genesis_time,
@@ -197,3 +215,5 @@ class Node:
             self.deadliner.stop()
         if self._gc_task is not None:
             self._gc_task.cancel()
+        if self._lag_task is not None:
+            self._lag_task.cancel()

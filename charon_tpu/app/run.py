@@ -47,9 +47,11 @@ from ..p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
                              P2PPriorityExchange)
 from ..p2p.transport import TCPMesh, mesh_params_from_definition
 from ..tbls import api as tbls
+from ..tbls import dispatch
 from . import featureset, log as applog, otlp, tracing
 from .lifecycle import Manager, StartOrder, StopOrder
-from .monitoring import MonitoringAPI, Registry, set_readiness
+from .monitoring import (MonitoringAPI, Registry, loop_lag_probe,
+                         set_readiness)
 from .qbftdebug import QBFTSniffer
 from .peerinfo import PeerInfo
 from .retry import Retryer, with_async_retry
@@ -220,13 +222,20 @@ class App:
                                   tracer=self.tracer_spans,
                                   trace_id_fn=tracing.duty_trace_id)
         dutydb = MemDutyDB()
+        # Off-loop dispatch pipeline: ALL device launches (verify +
+        # combine) go through its host-prep/launch executor threads so a
+        # multi-hundred-ms pairing batch or cold compile never blocks the
+        # event loop (None when CHARON_TPU_DISPATCH=0 pins the legacy
+        # inline behaviour).
+        self.dispatcher = dispatch.default_pipeline()
         # Shared micro-batching verifier: both partial-sig verify call-sites
         # — local-VC submissions (reference: core/validatorapi/
         # validatorapi.go:1052-1068) and inbound peer exchange (reference:
         # core/parsigex/parsigex.go:152-176) — coalesce into one
         # tbls.batch_verify device launch per event-loop tick.
         self.verifier = BatchVerifier(on_launch=self._on_verify_launch,
-                                      tracer=self.tracer_spans)
+                                      tracer=self.tracer_spans,
+                                      dispatcher=self.dispatcher)
         vapi = ValidatorAPI(share_idx=share_idx,
                             pubshare_by_group=pubshares,
                             fork_version=fork,
@@ -236,7 +245,8 @@ class App:
         parsigdb = MemParSigDB(threshold)
         parsigex = P2PParSigEx(self.mesh, verify_fn=self._verify_external,
                                registry=self.registry)
-        sigagg = SigAgg(threshold, tracer=self.tracer_spans)
+        sigagg = SigAgg(threshold, tracer=self.tracer_spans,
+                        dispatcher=self.dispatcher)
         aggsigdb = MemAggSigDB()
         bcast = Broadcaster(self.eth2cl, self.genesis_time,
                             self.slot_duration,
@@ -468,6 +478,45 @@ class App:
                     pass
             await asyncio.sleep(self.cfg.ping_interval)
 
+    async def _loop_lag_probe(self) -> None:
+        """Event-loop health self-probe: `app_event_loop_lag_seconds` +
+        the dispatch queue-depth gauge — the before/after witness that
+        device launches really run off-loop."""
+        await loop_lag_probe(self.registry, dispatcher=self.dispatcher)
+
+    async def _dispatch_prewarm(self) -> None:
+        """Boot-time shape prewarm (CHARON_TPU_DISPATCH_PREWARM): compile
+        the production kernel programs at this cluster's (V, T) buckets
+        and pre-decompress every peer's pubshares on the dispatch launch
+        thread, so the FIRST duty of the first slot never eats a cold
+        XLA compile (the cold-compile-stalls-expire-duties failure mode).
+        Backends without device programs (cpu, insecure-test) report a
+        skip and cost nothing."""
+        import logging
+
+        if not dispatch.prewarm_enabled():
+            return
+        shares = sorted({ps for by_pk in self._pubshares_by_peer.values()
+                         for ps in by_pk.values()})
+        v = len(self.lock.validators)
+        t = self.lock.definition.threshold
+        try:
+            if self.dispatcher is not None:
+                report = await self.dispatcher.prewarm(shares, v, t)
+            else:
+                # CHARON_TPU_DISPATCH=0: no launch thread, but the
+                # compiles must STILL stay off the event loop — an
+                # inline prewarm would be the very stall this PR removes
+                report = await asyncio.to_thread(tbls.prewarm, shares,
+                                                 v, t)
+        except Exception:  # noqa: BLE001 — prewarm must never kill boot
+            logging.getLogger(__name__).exception("dispatch prewarm failed")
+            return
+        if "total_s" in report:
+            self.registry.set_gauge("app_dispatch_prewarm_seconds",
+                                    report["total_s"])
+        logging.getLogger(__name__).info("dispatch prewarm: %s", report)
+
     async def _bn_sync_loop(self) -> None:
         while True:
             try:
@@ -497,6 +546,15 @@ class App:
                             self._start_peerinfo)
         life.register_start(StartOrder.MONITOR_API, "monitoring",
                             self._start_monitoring)
+        life.register_start(StartOrder.MONITOR_API, "loop-lag-probe",
+                            self._loop_lag_probe, background=True)
+        # background, and on a DEDICATED prewarm thread (not the launch
+        # pool — see DispatchPipeline.prewarm): first duties' launches
+        # are never queued behind the big (V, T) compiles; a duty that
+        # needs a shape prewarm is still compiling just finishes that
+        # compile itself under jax's per-program locks
+        life.register_start(StartOrder.MONITOR_API, "dispatch-prewarm",
+                            self._dispatch_prewarm, background=True)
         life.register_start(StartOrder.VALIDATOR_API, "vapi-router",
                             self.router.start)
         life.register_start(StartOrder.SCHEDULER, "gc-loop", self._gc_loop,

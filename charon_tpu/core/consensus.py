@@ -235,12 +235,14 @@ class QBFTConsensus:
         new_round = round_
         if msg is not None and rule in self._JUMP_RULES:
             new_round = max(round_, msg.round)
+        round_observed = False
         if reg is not None:
             if rule == qbft.UponRule.ROUND_TIMEOUT:
                 reg.inc("core_qbft_timeouts_total", labels=dlabel)
             if new_round > state.round:
                 reg.observe("core_qbft_round_duration_seconds",
                             now - state.round_start, labels=dlabel)
+                round_observed = True
                 reg.inc("core_qbft_round_changes_total",
                         float(new_round - state.round), labels=dlabel)
                 self._export_round_gauges(duty, new_round)
@@ -254,8 +256,13 @@ class QBFTConsensus:
                     qbft.UponRule.JUSTIFIED_DECIDED):
             state.decided = True
             if reg is not None:
-                reg.observe("core_qbft_round_duration_seconds",
-                            now - state.round_start, labels=dlabel)
+                # a decide that also jumped rounds (laggard catching up
+                # via JUSTIFIED_DECIDED) already observed the closing
+                # round's duration above — a second sample here would be
+                # a spurious ~0 s entry deflating the histogram
+                if not round_observed:
+                    reg.observe("core_qbft_round_duration_seconds",
+                                now - state.round_start, labels=dlabel)
                 reg.inc("core_qbft_decided_total", labels=dlabel)
             self._finish_span(state, now)
 

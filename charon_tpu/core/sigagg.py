@@ -11,6 +11,13 @@ parsigdb store — the whole validator set in the happy path) are coalesced
 into ONE `tbls.threshold_combine` launch, turning m per-validator CPU
 interpolations into a single [m, t]-shaped device MSM (BASELINE.md north
 star).  A `flush_interval` of 0 keeps p99 latency at one loop tick.
+
+The combine launch runs OFF the event loop through
+`tbls.dispatch.DispatchPipeline` (host byte-packing on the prep thread,
+the MSM on the launch thread), so the paper's invariant — aggregation
+never blocks the duty pipeline (core/sigagg/sigagg.go:75-77) — holds
+even for multi-hundred-ms batches.  ``CHARON_TPU_DISPATCH=0`` pins the
+legacy inline behaviour.
 """
 
 from __future__ import annotations
@@ -20,6 +27,7 @@ import contextlib
 from dataclasses import dataclass
 
 from ..tbls import api as tbls
+from ..tbls import dispatch
 from .types import Duty, ParSignedData, PubKey
 
 
@@ -33,11 +41,14 @@ class _Pending:
 
 class SigAgg:
     def __init__(self, threshold: int, flush_interval: float = 0.0,
-                 tracer=None):
+                 tracer=None, dispatcher=None):
         self._threshold = threshold
         self._flush_interval = flush_interval
         self._subs: list = []
         self._queue: list[_Pending] = []
+        # tbls.dispatch.DispatchPipeline owning the off-loop launches;
+        # None = resolve the process default per flush
+        self._dispatcher = dispatcher
         # app.tracing.Tracer: each coalesced combine becomes a
         # "tpu/threshold_combine" span (batch, T, MSM path, padded rows)
         self._tracer = tracer
@@ -51,13 +62,16 @@ class SigAgg:
         combine containing it completes."""
         if len(parsigs) < self._threshold:
             raise ValueError("insufficient partial signatures")
-        fut = asyncio.get_event_loop().create_future()
+        # get_running_loop, not get_event_loop (deprecated in coroutines
+        # on 3.12+, and wrong-loop-prone when called from a thread)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
         self._queue.append(_Pending(duty, pubkey, list(parsigs), fut))
         # Every call spawns a flusher; after the coalescing sleep the first
         # one to wake drains the whole queue and the rest no-op.  (A shared
         # "is a flusher running" flag would race: entries enqueued while a
         # flusher is mid-combine would never be picked up.)
-        asyncio.get_event_loop().create_task(self._flush())
+        loop.create_task(self._flush())
         await fut
 
     async def _flush(self) -> None:
@@ -75,14 +89,22 @@ class SigAgg:
             for item in batch
         ]
         t = max(len(s) for s in sig_sets)
+        pipe = self._dispatcher
+        if pipe is None:
+            pipe = dispatch.default_pipeline()
         span = (self._tracer.start_span(
             "tpu/threshold_combine", batch=len(batch), t=t,
             path=tbls.combine_path(),
-            padded_rows=tbls.combine_padded_rows(len(batch), t))
+            padded_rows=tbls.combine_padded_rows(len(batch), t),
+            queue_depth=pipe.queue_depth if pipe is not None else -1)
             if self._tracer is not None else contextlib.nullcontext())
         try:
             with span:
-                combined = tbls.threshold_combine(sig_sets)  # ONE launch
+                if pipe is None:    # CHARON_TPU_DISPATCH=0: legacy inline
+                    combined = tbls.threshold_combine(sig_sets)
+                else:
+                    # ONE coalesced launch, awaited off-loop
+                    combined = await pipe.threshold_combine(sig_sets)
         except Exception as exc:
             for item in batch:
                 if not item.done.done():

@@ -13,6 +13,15 @@ A `flush_interval` of 0 keeps worst-case added latency at one loop tick.
 Counters (`launches`, `entries_total`, `max_batch`, per-path `paths`)
 surface batching efficacy at /metrics and in tests.
 
+Launches run OFF the event loop: each coalesced flush is awaited through
+`tbls.dispatch.DispatchPipeline` (host-prep thread + launch thread, the
+prep of batch k+1 overlapping the device execution of batch k), so a
+multi-hundred-ms pairing batch — or a cold XLA compile — no longer
+freezes QBFT timers, transport frames and slot-budget hand-offs for its
+duration.  ``CHARON_TPU_DISPATCH=0`` pins the legacy inline behaviour;
+``CHARON_TPU_LOOP_GUARD=1`` turns any regression back to inline device
+calls into an error (the core-service test suites enable it).
+
 Coalescing matters twice over on the TPU backend: beyond amortising the
 launch, the batched `tbls.batch_verify` it lands in runs the fused pallas
 random-linear-combination check (tbls/backend_tpu) — 2 Miller-loop rows
@@ -30,6 +39,7 @@ import contextlib
 from dataclasses import dataclass, field
 
 from ..tbls import api as tbls
+from ..tbls import dispatch
 
 
 @dataclass
@@ -40,10 +50,14 @@ class _Pending:
 
 class BatchVerifier:
     def __init__(self, flush_interval: float = 0.0, on_launch=None,
-                 tracer=None):
+                 tracer=None, dispatcher=None):
         self._flush_interval = flush_interval
         self._queue: list[_Pending] = []
         self._on_launch = on_launch  # fn(self), called after every launch
+        # tbls.dispatch.DispatchPipeline owning the off-loop launches;
+        # None = resolve the process default per flush (which honours
+        # CHARON_TPU_DISPATCH=0 → legacy inline launches)
+        self._dispatcher = dispatcher
         # app.tracing.Tracer: each coalesced launch becomes a
         # "tpu/batch_verify" span (batch size, pairing path, padded rows)
         self._tracer = tracer
@@ -66,14 +80,17 @@ class BatchVerifier:
         parsigex message); returns their verdicts in order."""
         if not entries:
             return []
-        item = _Pending(entries=list(entries),
-                        done=asyncio.get_event_loop().create_future())
+        # get_running_loop, not get_event_loop: the latter is deprecated
+        # inside coroutines (3.12+) and silently binds the WRONG loop when
+        # a service object is shared across threads
+        loop = asyncio.get_running_loop()
+        item = _Pending(entries=list(entries), done=loop.create_future())
         self._queue.append(item)
         # Every call spawns a flusher; after the coalescing sleep the first
         # one to wake drains the whole queue and the rest no-op (same
         # rationale as sigagg: a shared "flusher running" flag would race
         # with entries enqueued mid-launch).
-        asyncio.get_event_loop().create_task(self._flush())
+        loop.create_task(self._flush())
         return await item.done
 
     async def _flush(self) -> None:
@@ -85,15 +102,32 @@ class BatchVerifier:
         if not batch:
             return  # a sibling flusher already drained the queue
         flat = [e for item in batch for e in item.entries]
+        pipe = self._dispatcher
+        if pipe is None:
+            pipe = dispatch.default_pipeline()
+        # per-TILE attribution: the pipeline splits a large flush into
+        # sub-launches, and each tile resolves its own pairing path /
+        # padding (a small remainder tile can take the jnp path while
+        # the full tiles run fused — the span and the per-path counters
+        # must surface that, not describe an imaginary monolithic batch)
+        sizes = (pipe.plan_verify(len(flat)) if pipe is not None
+                 else [len(flat)])
+        tile_paths = [tbls.verify_path(s) for s in sizes]
         span = (self._tracer.start_span(
             "tpu/batch_verify", batch=len(flat),
-            path=tbls.verify_path(len(flat)),
-            padded_rows=tbls.verify_padded_rows(len(flat)),
-            coalesced_calls=len(batch))
+            path="+".join(sorted(set(tile_paths))),
+            padded_rows=sum(tbls.verify_padded_rows(s) for s in sizes),
+            coalesced_calls=len(batch), tiles=len(sizes),
+            queue_depth=pipe.queue_depth if pipe is not None else -1)
             if self._tracer is not None else contextlib.nullcontext())
         try:
             with span:
-                oks = tbls.batch_verify(flat)   # ONE device launch
+                if pipe is None:    # CHARON_TPU_DISPATCH=0: legacy inline
+                    oks = tbls.batch_verify(flat)
+                else:
+                    # ONE coalesced launch unit, awaited off-loop (tiled
+                    # into pipelined sub-launches above the dispatch tile)
+                    oks = await pipe.batch_verify(flat)
         except Exception as exc:
             for item in batch:
                 if not item.done.done():
@@ -102,8 +136,8 @@ class BatchVerifier:
         self.launches += 1
         self.entries_total += len(flat)
         self.max_batch = max(self.max_batch, len(flat))
-        path = tbls.verify_path(len(flat))
-        self.paths[path] = self.paths.get(path, 0) + 1
+        for path in tile_paths:     # one count per sub-launch tile
+            self.paths[path] = self.paths.get(path, 0) + 1
         pos = 0
         for item in batch:
             n = len(item.entries)

@@ -26,7 +26,7 @@ from ..eth2util import keystore
 from ..eth2util.spec import DepositData
 from ..p2p.transport import TCPMesh, encode_json, decode_json
 from ..tbls import api as tbls
-from ..tbls import shamir
+from ..tbls import dispatch, shamir
 from . import keygen
 
 SYNC_PROTOCOL = "/charon_tpu/dkg/sync/1.0.0"
@@ -240,28 +240,54 @@ class Ceremony:
         if self.n > 1:
             await asyncio.wait_for(self._locksig_evt.wait(), timeout)
 
-        def combine(v: int, r: keygen.KeygenResult, kind: str,
-                    root: bytes) -> bytes:
-            partials = {}
-            for sender, sigs in self._lock_sigs.items():
-                sig = bytes.fromhex(sigs[kind][v])
-                if not tbls.verify(r.pubshares[sender + 1], root, sig):
-                    raise ValueError(
-                        f"bad {kind} partial sig from operator {sender}")
-                partials[sender + 1] = sig
-            group_sig = tbls.aggregate(
-                dict(list(partials.items())[: self.t]))
-            if not tbls.verify(r.group_pubkey, root, group_sig):
-                raise ValueError(f"{kind} group signature invalid")
-            return group_sig
+        # Every per-partial verification, the threshold combines, and
+        # the group-signature verifications run as BATCHED launches
+        # awaited OFF the event loop through the dispatch pipeline: this
+        # coroutine must not block the mesh handlers mid-ceremony on
+        # inline device work (V·2·n serial pairings before, and the
+        # armed CHARON_TPU_LOOP_GUARD rejects inline batch entry
+        # points).  Row order: (v0 lock, v0 deposit, v1 lock, …).
+        pipe = dispatch.default_pipeline()
 
-        group_sigs, deposits = [], []
+        async def verify_batch(entries):
+            return (await pipe.batch_verify(entries) if pipe is not None
+                    else tbls.batch_verify(entries))
+
+        rows = []       # (r, kind, root) aligned with the combine batch
+        row_partials = []
+        ver_entries, ver_meta = [], []
         for v, (r, droot) in enumerate(zip(results, dep_roots)):
-            group_sigs.append(combine(v, r, "lock", msg))
-            deposits.append(DepositData(
+            for kind, root in (("lock", msg), ("deposit", droot)):
+                partials = {}
+                for sender, sigs in self._lock_sigs.items():
+                    sig = bytes.fromhex(sigs[kind][v])
+                    ver_entries.append((r.pubshares[sender + 1], root, sig))
+                    ver_meta.append((kind, sender))
+                    partials[sender + 1] = sig
+                rows.append((r, kind, root))
+                row_partials.append(partials)
+        for ok, (kind, sender) in zip(await verify_batch(ver_entries),
+                                      ver_meta):
+            if not ok:
+                raise ValueError(
+                    f"bad {kind} partial sig from operator {sender}")
+        batch = [dict(list(p.items())[: self.t]) for p in row_partials]
+        combined = (await pipe.threshold_combine(batch)
+                    if pipe is not None
+                    else tbls.threshold_combine(batch))
+        group_entries = [(r.group_pubkey, root, sig)
+                         for (r, kind, root), sig in zip(rows, combined)]
+        for ok, (r, kind, root) in zip(await verify_batch(group_entries),
+                                       rows):
+            if not ok:
+                raise ValueError(f"{kind} group signature invalid")
+        group_sigs = combined[0::2]
+        deposits = [
+            DepositData(
                 pubkey=r.group_pubkey, withdrawal_credentials=withdrawal_creds,
                 amount=deposit_mod.DEPOSIT_AMOUNT_GWEI,
-                signature=combine(v, r, "deposit", droot)))
+                signature=combined[2 * v + 1])
+            for v, r in enumerate(results)]
 
         return (Lock(definition=self.definition, validators=validators,
                      signature_aggregate=b"".join(group_sigs)), deposits)

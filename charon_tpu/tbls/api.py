@@ -18,7 +18,7 @@ from __future__ import annotations
 import secrets
 from dataclasses import dataclass, field
 
-from . import shamir
+from . import dispatch, shamir
 from .ref import bls, curve
 from .ref.fields import R
 from .ref.hash_to_curve import DST_G2
@@ -239,7 +239,12 @@ def verify_and_aggregate(tss: TSS, partial_sigs: dict[int, Signature],
 # ---------------------------------------------------------------------------
 
 def batch_verify(entries: list[tuple[PubKey, bytes, Signature]]) -> list[bool]:
-    """Verify a batch of (pubkey, msg, signature) triples."""
+    """Verify a batch of (pubkey, msg, signature) triples.
+
+    Blocking entry point — run it off the event loop (the core services
+    go through `dispatch.DispatchPipeline`; ``CHARON_TPU_LOOP_GUARD=1``
+    turns an inline on-loop call into an error)."""
+    dispatch.assert_off_loop("tbls.batch_verify")
     if _scheme == "insecure-test":
         return [_InsecureScheme.verify(pk, msg, sig)
                 for pk, msg, sig in entries]
@@ -266,7 +271,9 @@ def batch_verify(entries: list[tuple[PubKey, bytes, Signature]]) -> list[bool]:
 def threshold_combine(
         batch: list[dict[int, Signature]]) -> list[Signature]:
     """Lagrange-combine many validators' partial-signature sets at once —
-    the batched MSM the TPU kernels own."""
+    the batched MSM the TPU kernels own.  Blocking entry point — see
+    :func:`batch_verify` for the off-loop contract."""
+    dispatch.assert_off_loop("tbls.threshold_combine")
     if _scheme == "insecure-test":
         return [_InsecureScheme.combine(sigs) for sigs in batch]
     be = _backend()
@@ -277,6 +284,64 @@ def threshold_combine(
     ]
     combined = _backend().threshold_combine(parsed)
     return [curve.g2_to_bytes(pt) for pt in combined]
+
+
+# ---------------------------------------------------------------------------
+# Pipelined-dispatch stage surface (tbls.dispatch.DispatchPipeline)
+# ---------------------------------------------------------------------------
+#
+# Backends that implement the explicit host-prep / device-exec split
+# (`verify_host_prep`/`verify_device_exec`, `combine_host_prep`/
+# `combine_device_exec` — the TPU backend) get true double-buffering:
+# the prep thread packs batch k+1 while the launch thread executes
+# batch k.  Everything else (CPU backend, insecure-test scheme) degrades
+# to identity-prep + whole-call-exec, which still moves the blocking
+# work off the event loop.  Stages are resolved PER CALL so scheme and
+# backend switches (and test monkeypatches of `batch_verify`) take
+# effect between flushes.
+
+def _generic_stages(exec_fn):
+    def prep(payload):
+        return payload
+
+    return prep, exec_fn
+
+
+def verify_stages():
+    """(host_prep, device_exec) callables for one verify payload:
+    ``device_exec(host_prep(entries)) == batch_verify(entries)``."""
+    if _scheme != "insecure-test":
+        be = _backend()
+        if hasattr(be, "verify_host_prep"):
+            return be.verify_host_prep, be.verify_device_exec
+    return _generic_stages(lambda entries: batch_verify(entries))
+
+
+def combine_stages():
+    """(host_prep, device_exec) callables for one combine payload:
+    ``device_exec(host_prep(batch)) == threshold_combine(batch)``."""
+    if _scheme != "insecure-test":
+        be = _backend()
+        if hasattr(be, "combine_host_prep"):
+            return be.combine_host_prep, be.combine_device_exec
+    return _generic_stages(lambda batch: threshold_combine(batch))
+
+
+def prewarm(pubshares: list[PubKey], num_validators: int,
+            threshold: int) -> dict:
+    """Compile the production kernel programs at the shape buckets the
+    cluster (V, T) implies and pre-decompress the cluster pubshares, so
+    the first duty after boot never eats a cold XLA compile.  Blocking —
+    callers run it on the dispatch launch thread
+    (`DispatchPipeline.prewarm`).  No-ops (with a reason) on backends
+    without a device prewarm."""
+    if _scheme == "insecure-test":
+        return {"skipped": "insecure-test scheme"}
+    be = _backend()
+    fn = getattr(be, "prewarm", None)
+    if fn is None:
+        return {"skipped": f"backend {be.name!r} has no device programs"}
+    return fn(pubshares, num_validators, threshold)
 
 
 # ---------------------------------------------------------------------------

@@ -23,13 +23,15 @@ import functools
 import logging
 import math
 import os
+import threading
+import time
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import shamir
+from . import dispatch, shamir
 from ..ops import codec
 from ..ops import curve as jcurve
 from ..ops import fp
@@ -571,6 +573,7 @@ class TPUBackend:
         n = len(entries)
         if n == 0:
             return []
+        dispatch.assert_off_loop("tbls.backend_tpu.batch_verify")
         v = _pad_pow2(n)
         ps = np.zeros((v, 2, 3, jcurve.fp.NLIMBS), np.int32)
         qs = np.zeros((v, 2, 3, 2, jcurve.fp.NLIMBS), np.int32)
@@ -593,6 +596,7 @@ class TPUBackend:
         group-signature points — Σᵢ λᵢ·Sᵢ per validator, one MSM launch."""
         if not batch:
             return []
+        dispatch.assert_off_loop("tbls.backend_tpu.threshold_combine")
         v = _pad_pow2(len(batch))
         t = _pad_pow2(max(len(sigs) for sigs in batch))
         pts = np.zeros((v, t, 3, 2, jcurve.fp.NLIMBS), np.int32)
@@ -609,6 +613,32 @@ class TPUBackend:
         return jcurve.g2_unpack(out)[: len(batch)]
 
     # -- bytes-native paths (no Python loop over validators) ----------------
+    #
+    # Each bytes path is split into an explicit HOST-PREP stage (byte
+    # shuffling, Lagrange bit/digit cache lookups, compressed-wire
+    # splitting, hashed-message/pubkey cache lookups) and a DEVICE stage
+    # (the jit'd kernel launches + result fetch), so the dispatch
+    # pipeline (tbls/dispatch.py) can run them on separate threads and
+    # overlap batch k+1's prep with batch k's launch.  The classic
+    # entry points remain the composition of the two stages.
+
+    def combine_host_prep(self, batch) -> dict:
+        """Host stage of `threshold_combine_bytes` — everything before
+        the first device launch."""
+        if not batch:
+            return {"kind": "empty"}
+        if _use_fused():
+            return self._combine_prep_fused(batch)
+        return self._combine_prep_jnp(batch)
+
+    def combine_device_exec(self, prepared: dict) -> list[bytes]:
+        """Device stage of `threshold_combine_bytes` (launch thread)."""
+        if prepared["kind"] == "empty":
+            return []
+        dispatch.assert_off_loop("tbls.backend_tpu.combine_device_exec")
+        if prepared["kind"] == "fused":
+            return self._combine_exec_fused(prepared)
+        return self._combine_exec_jnp(prepared)
 
     def threshold_combine_bytes(self, batch) -> list[bytes]:
         """batch: list of {share_idx: 96-byte sig}; returns 96-byte group
@@ -616,10 +646,9 @@ class TPUBackend:
         arrays: host work is one vectorised bit-shuffle; decompression
         (batched Fp2 sqrt), Lagrange MSM and normalisation are one fused
         device launch (reference per-validator CPU path: tbls/tss.go:142-149)."""
-        if not batch:
-            return []
-        if _use_fused():
-            return self._combine_bytes_fused(batch)
+        return self.combine_device_exec(self.combine_host_prep(batch))
+
+    def _combine_prep_jnp(self, batch) -> dict:
         v = _pad_pow2(len(batch))
         t = _pad_pow2(max(len(sigs) for sigs in batch))
         raw = np.broadcast_to(_G2_INF_BYTES, (v, t, 96)).copy()
@@ -635,24 +664,32 @@ class TPUBackend:
         xc0, xc1, sign, inf, bad = codec.g2_bytes_split(raw.reshape(-1, 96))
         if bad[: len(batch) * t].any():
             raise ValueError("malformed compressed G2 signature in batch")
+        return {"kind": "jnp", "nv": len(batch), "v": v, "t": t,
+                "xc0": xc0, "xc1": xc1, "sign": sign, "inf": inf,
+                "bits": bits}
+
+    def _combine_exec_jnp(self, p: dict) -> list[bytes]:
+        nv, v, t = p["nv"], p["v"], p["t"]
         shape = (v, t, jcurve.fp.NLIMBS)
         pts, ok = _decompress_kernel(
-            jnp.asarray(xc0.reshape(shape)), jnp.asarray(xc1.reshape(shape)),
-            jnp.asarray(sign.reshape(v, t)), jnp.asarray(inf.reshape(v, t)))
+            jnp.asarray(p["xc0"].reshape(shape)),
+            jnp.asarray(p["xc1"].reshape(shape)),
+            jnp.asarray(p["sign"].reshape(v, t)),
+            jnp.asarray(p["inf"].reshape(v, t)))
         oxc0, oxc1, oyc0, oyc1, oinf = _msm_normalize_kernel(
-            pts, jnp.asarray(bits))
-        if not np.asarray(ok)[: len(batch)].all():
+            pts, jnp.asarray(p["bits"]))
+        if not np.asarray(ok)[:nv].all():
             raise ValueError("signature bytes not on the G2 curve")
         out = codec.g2_compress_np(np.asarray(oxc0), np.asarray(oxc1),
                                    np.asarray(oyc0), np.asarray(oyc1),
                                    np.asarray(oinf))
-        return [out[k].tobytes() for k in range(len(batch))]
+        return [out[k].tobytes() for k in range(nv)]
 
-    def _combine_bytes_fused(self, batch) -> list[bytes]:
-        """Fused-kernel combine: rows laid out T-MAJOR (row = t·Vpad + v,
-        so the T-axis tree sum is contiguous S-slices), validators padded
-        to a 1024-row tile multiple (NOT pow2 — at V = 10k that alone
-        wastes 1.6× work), T exact."""
+    def _combine_prep_fused(self, batch) -> dict:
+        """Fused-kernel combine, host stage: rows laid out T-MAJOR
+        (row = t·Vpad + v, so the T-axis tree sum is contiguous
+        S-slices), validators padded to a 1024-row tile multiple (NOT
+        pow2 — at V = 10k that alone wastes 1.6× work), T exact."""
         nv = len(batch)
         vpad = max(1024, -(-nv // 1024) * 1024)
         t = max(len(sigs) for sigs in batch)
@@ -675,29 +712,41 @@ class TPUBackend:
         real = (np.arange(t)[:, None] < counts[None, :]).reshape(-1)
         if (bad & real).any():
             raise ValueError("malformed compressed G2 signature in batch")
-        shape = (t * vpad, jcurve.fp.NLIMBS)
-        pts, ok = _decompress_kernel(
-            jnp.asarray(xc0.reshape(shape)), jnp.asarray(xc1.reshape(shape)),
-            jnp.asarray(sign.reshape(-1)), jnp.asarray(inf.reshape(-1)))
         if straus:
             # [t, vpad, 87] → iteration-major [87, S, 128] t-major rows
-            digits = np.ascontiguousarray(
+            scal = np.ascontiguousarray(
                 scal.reshape(t * vpad, nwin).T.reshape(
                     nwin, t * vpad // 128, 128))
+        else:
+            scal = pallas_g2.windows_from_bits(scal.reshape(-1, nwin))
+        return {"kind": "fused", "batch": batch, "nv": nv, "vpad": vpad,
+                "t": t, "straus": straus, "xc0": xc0, "xc1": xc1,
+                "sign": sign, "inf": inf, "scal": scal, "real": real}
+
+    def _combine_exec_fused(self, p: dict) -> list[bytes]:
+        nv, vpad, t = p["nv"], p["vpad"], p["t"]
+        shape = (t * vpad, jcurve.fp.NLIMBS)
+        pts, ok = _decompress_kernel(
+            jnp.asarray(p["xc0"].reshape(shape)),
+            jnp.asarray(p["xc1"].reshape(shape)),
+            jnp.asarray(p["sign"].reshape(-1)),
+            jnp.asarray(p["inf"].reshape(-1)))
+        if p["straus"]:
             try:
                 oxc0, oxc1, oyc0, oyc1, oinf = _msm_straus_normalize_kernel(
-                    pts, jnp.asarray(digits), t)
+                    pts, jnp.asarray(p["scal"]), t)
             except Exception as exc:
                 # a Straus kernel regression (e.g. an AOT scoped-VMEM OOM
                 # the preflight audit was skipped for) degrades to the
-                # round-4 dblsel path instead of failing the combine
+                # round-4 dblsel path instead of failing the combine; the
+                # latched _msm_kind makes the re-prep emit dblsel planes
                 _note_straus_failure(exc)
-                return self._combine_bytes_fused(batch)
+                return self.combine_device_exec(
+                    self.combine_host_prep(p["batch"]))
         else:
-            windows = pallas_g2.windows_from_bits(scal.reshape(-1, nwin))
             oxc0, oxc1, oyc0, oyc1, oinf = _msm_fused_normalize_kernel(
-                pts, jnp.asarray(windows), t)
-        if not (np.asarray(ok) | ~real).all():
+                pts, jnp.asarray(p["scal"]), t)
+        if not (np.asarray(ok) | ~p["real"]).all():
             raise ValueError("signature bytes not on the G2 curve")
         out = codec.g2_compress_np(np.asarray(oxc0), np.asarray(oxc1),
                                    np.asarray(oyc0), np.asarray(oyc1),
@@ -718,6 +767,13 @@ class TPUBackend:
     #: mirroring the decompressed-pubkey cache)
     hm_cache_hits = 0
     hm_cache_misses = 0
+    #: guards the LRU/pk cache mutation sequences: since the dispatch
+    #: pipeline split, host prep runs on the prep thread while the boot
+    #: prewarm (and the fused→jnp fallback re-prep) run the same cache
+    #: code on the launch thread — an unlocked move_to_end/popitem race
+    #: would corrupt the OrderedDict links.  Device launches for misses
+    #: happen OUTSIDE the lock (they can take seconds).
+    _CACHE_LOCK = threading.Lock()
 
     def _h2c_points_device(self, keys, dst: bytes = DST_G2) -> np.ndarray:
         """Batched device hash-to-G2 for a distinct-message list: host
@@ -756,21 +812,23 @@ class TPUBackend:
         out = np.zeros((len(msgs), 3, 2, jcurve.fp.NLIMBS), np.int32)
         cache = self._HM_CACHE
         miss: dict[bytes, list] = {}
-        for k, msg in enumerate(msgs):
-            hm = cache.get(msg)
-            if hm is not None:
-                cache.move_to_end(msg)
-                out[k] = hm
-            else:
-                miss.setdefault(msg, []).append(k)
-        n_miss = sum(len(v) for v in miss.values())
-        type(self).hm_cache_hits += len(msgs) - n_miss
+        with self._CACHE_LOCK:
+            for k, msg in enumerate(msgs):
+                hm = cache.get(msg)
+                if hm is not None:
+                    cache.move_to_end(msg)
+                    out[k] = hm
+                else:
+                    miss.setdefault(msg, []).append(k)
+            n_miss = sum(len(v) for v in miss.values())
+            # counters share the lock: they are read-modify-writes from
+            # both stage threads since the dispatch split
+            type(self).hm_cache_hits += len(msgs) - n_miss
+            type(self).hm_cache_misses += n_miss
         if not miss:
             return out
         # lazy import: same rationale as the pubkey-cache span below
         from ..app.tracing import device_span
-
-        type(self).hm_cache_misses += n_miss
         keys = list(miss)
         path = "device" if _use_h2c(len(keys)) else "host"
         with device_span("tpu/hm_miss", misses=len(keys), batch=len(msgs),
@@ -786,13 +844,48 @@ class TPUBackend:
             if planes is None:
                 planes = np.stack(
                     [jcurve.g2_pack([hash_to_g2(msg)])[0] for msg in keys])
-        for j, msg in enumerate(keys):
-            if len(cache) >= self._HM_CACHE_MAX:
-                cache.popitem(last=False)
-            cache[msg] = planes[j]
-            for k in miss[msg]:
-                out[k] = planes[j]
+        with self._CACHE_LOCK:
+            for j, msg in enumerate(keys):
+                if len(cache) >= self._HM_CACHE_MAX:
+                    cache.popitem(last=False)
+                cache[msg] = planes[j]
+                for k in miss[msg]:
+                    out[k] = planes[j]
         return out
+
+    def verify_host_prep(self, entries) -> dict:
+        """Host stage of `batch_verify_bytes`: wire-byte splitting into
+        limb planes, hashed-message cache lookups (misses batch through
+        expand_message_xmd + the h2c path), decompressed-pubkey cache
+        lookups, malformed-entry flagging, RLC coefficient drawing.  A
+        cache miss may itself launch a device kernel (h2c / pk
+        decompress) — rare by design, and gone entirely once `prewarm`
+        has seeded the caches."""
+        n = len(entries)
+        if n == 0:
+            return {"kind": "empty"}
+        if _use_pairing_fused(n):
+            try:
+                return self._verify_prep_fused(entries)
+            except Exception as exc:
+                # a fused-pairing regression degrades to the jnp kernels
+                # instead of failing every verify (round-5 lesson)
+                _note_pairing_failure(exc)
+        return self._verify_prep_jnp(entries)
+
+    def verify_device_exec(self, prepared: dict) -> list[bool]:
+        """Device stage of `batch_verify_bytes` (launch thread)."""
+        if prepared["kind"] == "empty":
+            return []
+        dispatch.assert_off_loop("tbls.backend_tpu.verify_device_exec")
+        if prepared["kind"] == "fused":
+            try:
+                return self._verify_exec_fused(prepared)
+            except Exception as exc:
+                _note_pairing_failure(exc)
+                return self.verify_device_exec(
+                    self._verify_prep_jnp(prepared["entries"]))
+        return self._verify_exec_jnp(prepared)
 
     def batch_verify_bytes(self, entries) -> list[bool]:
         """entries: [(48-byte pk, msg bytes, 96-byte sig)] → [bool].
@@ -812,26 +905,17 @@ class TPUBackend:
         the jnp per-row kernel remains the oracle, the small-batch path,
         and the automatic fallback when the fused path cannot compile
         (CHARON_TPU_PAIRING, mirroring CHARON_TPU_MSM)."""
-        n = len(entries)
-        if n == 0:
-            return []
-        if _use_pairing_fused(n):
-            try:
-                return self._batch_verify_bytes_fused(entries)
-            except Exception as exc:
-                # a fused-pairing regression degrades to the jnp kernels
-                # instead of failing every verify (round-5 lesson)
-                _note_pairing_failure(exc)
-        return self._batch_verify_bytes_jnp(entries)
+        return self.verify_device_exec(self.verify_host_prep(entries))
 
-    def _parse_verify_entries(self, entries, v: int):
+    def _verify_prep_jnp(self, entries) -> dict:
         """Host prologue of the JNP verify path: split wire bytes into
         limb planes at padded batch v, hash messages (cached), flag
-        malformed entries, decompress on device (curve + subgroup
-        checks).  The fused path has its own prologue
-        (_batch_verify_bytes_fused) because its pk side goes through the
+        malformed entries.  The fused path has its own prologue
+        (_verify_prep_fused) because its pk side goes through the
         decompressed-pubkey cache — a new entry-validation rule must be
         applied to BOTH."""
+        n = len(entries)
+        v = _pad_pow2(n)
         pk_raw = np.zeros((v, 48), np.uint8)
         pk_raw[:, 0] = 0xC0
         sg_raw = np.broadcast_to(_G2_INF_BYTES, (v, 96)).copy()
@@ -850,22 +934,23 @@ class TPUBackend:
             hms[hm_rows] = self._hash_points(hm_msgs)
         pk_x, pk_sign, pk_inf, pk_bad = codec.g1_bytes_split(pk_raw)
         sg_xc0, sg_xc1, sg_sign, sg_inf, sg_bad = codec.g2_bytes_split(sg_raw)
-        pks, sigs, dec_ok = _verify_decompress_kernel(
-            jnp.asarray(pk_x), jnp.asarray(pk_sign), jnp.asarray(pk_inf),
-            jnp.asarray(sg_xc0), jnp.asarray(sg_xc1), jnp.asarray(sg_sign),
-            jnp.asarray(sg_inf))
         host_ok = length_ok & ~pk_bad & ~sg_bad
-        return pks, sigs, hms, dec_ok, host_ok
+        return {"kind": "jnp", "n": n, "pk_x": pk_x, "pk_sign": pk_sign,
+                "pk_inf": pk_inf, "sg_xc0": sg_xc0, "sg_xc1": sg_xc1,
+                "sg_sign": sg_sign, "sg_inf": sg_inf, "hms": hms,
+                "host_ok": host_ok}
 
-    def _batch_verify_bytes_jnp(self, entries) -> list[bool]:
+    def _verify_exec_jnp(self, p: dict) -> list[bool]:
         """Per-row jnp pairing kernel (2 Miller loops + 1 final
         exponentiation per signature) — the oracle path."""
-        n = len(entries)
-        v = _pad_pow2(n)
-        pks, sigs, hms, dec_ok, host_ok = self._parse_verify_entries(
-            entries, v)
-        ok = _verify_pairing_kernel(pks, sigs, jnp.asarray(hms))
-        ok = np.asarray(ok) & np.asarray(dec_ok) & host_ok
+        n = p["n"]
+        pks, sigs, dec_ok = _verify_decompress_kernel(
+            jnp.asarray(p["pk_x"]), jnp.asarray(p["pk_sign"]),
+            jnp.asarray(p["pk_inf"]), jnp.asarray(p["sg_xc0"]),
+            jnp.asarray(p["sg_xc1"]), jnp.asarray(p["sg_sign"]),
+            jnp.asarray(p["sg_inf"]))
+        ok = _verify_pairing_kernel(pks, sigs, jnp.asarray(p["hms"]))
+        ok = np.asarray(ok) & np.asarray(dec_ok) & p["host_ok"]
         return [bool(b) for b in ok[:n]]
 
     #: decompressed-pubkey cache: 48-byte wire pk → ([3, 32] planes, ok).
@@ -885,20 +970,21 @@ class TPUBackend:
         planes = np.zeros((m, 3, jcurve.fp.NLIMBS), np.int32)
         ok = np.zeros(m, bool)
         miss: dict[bytes, list] = {}
-        for k, pk in enumerate(pk_bytes_list):
-            hit = self._PK_CACHE.get(pk)
-            if hit is not None:
-                planes[k], ok[k] = hit
-            else:
-                miss.setdefault(pk, []).append(k)
-        type(self).pk_cache_hits += m - sum(len(v) for v in miss.values())
+        with self._CACHE_LOCK:
+            for k, pk in enumerate(pk_bytes_list):
+                hit = self._PK_CACHE.get(pk)
+                if hit is not None:
+                    planes[k], ok[k] = hit
+                else:
+                    miss.setdefault(pk, []).append(k)
+            n_miss = sum(len(v) for v in miss.values())
+            type(self).pk_cache_hits += m - n_miss
+            type(self).pk_cache_misses += n_miss
         if miss:
             # lazy import: app.tracing imports nothing from tbls, and
             # importing at module scope would drag the app layer into
             # every bench/ops process that only wants kernels
             from ..app.tracing import device_span
-
-            type(self).pk_cache_misses += sum(len(v) for v in miss.values())
             keys = list(miss)
             mp = _pad_pow2(len(keys), floor=8)
             with device_span("tpu/pk_decompress_miss", misses=len(keys),
@@ -911,16 +997,20 @@ class TPUBackend:
                 pts, dec = _pk_decompress_kernel(
                     jnp.asarray(x), jnp.asarray(sign), jnp.asarray(inf))
                 pts, dec = np.asarray(pts), np.asarray(dec) & ~bad
-            if len(self._PK_CACHE) > 65536:
-                self._PK_CACHE.clear()
-            for j, pk in enumerate(keys):
-                self._PK_CACHE[pk] = (pts[j], bool(dec[j]))
-                for k in miss[pk]:
-                    planes[k], ok[k] = pts[j], bool(dec[j])
+            with self._CACHE_LOCK:
+                if len(self._PK_CACHE) > 65536:
+                    self._PK_CACHE.clear()
+                for j, pk in enumerate(keys):
+                    self._PK_CACHE[pk] = (pts[j], bool(dec[j]))
+                    for k in miss[pk]:
+                        planes[k], ok[k] = pts[j], bool(dec[j])
         return planes, ok
 
-    def _batch_verify_bytes_fused(self, entries) -> list[bool]:
-        """Fused pallas RLC batch verification (module docstring above)."""
+    def _verify_prep_fused(self, entries) -> dict:
+        """Host prologue of the fused pallas RLC batch verification
+        (module docstring above): hashed-message + decompressed-pubkey
+        cache lookups, signature wire-byte splitting, fresh RLC
+        coefficient windows."""
         n = len(entries)
         v = max(_VERIFY_MIN_ROWS // 2, _pad_pow2(n))
         inf_pk = jcurve.g1_pack([None])[0]
@@ -949,24 +1039,34 @@ class TPUBackend:
                 j = next(it)
                 pk_rows[k] = pk_planes[j]
                 host_ok[k] &= bool(pk_ok[j])
-        pks = jnp.asarray(np.stack(pk_rows))
         sg_xc0, sg_xc1, sg_sign, sg_inf, sg_bad = codec.g2_bytes_split(sg_raw)
-        sigs, sg_ok = _sig_decompress_kernel(
-            jnp.asarray(sg_xc0), jnp.asarray(sg_xc1), jnp.asarray(sg_sign),
-            jnp.asarray(sg_inf))
-        live = host_ok & ~sg_bad & np.asarray(sg_ok)
-        live[n:] = False
         # fresh per-entry random coefficients every call: a plain product
         # admits adversarial cross-row cancellation; the RLC rejects any
         # invalid subset except with probability ~2^-64
         r_bits = np.random.default_rng().integers(
             0, 2, (v, _RLC_BITS)).astype(np.int32)
         windows = pallas_g2.windows_from_bits(np.repeat(r_bits, 2, axis=0))
+        return {"kind": "fused", "entries": entries, "n": n, "v": v,
+                "pks": np.stack(pk_rows), "sg_xc0": sg_xc0,
+                "sg_xc1": sg_xc1, "sg_sign": sg_sign, "sg_inf": sg_inf,
+                "sg_bad": sg_bad, "hms": hms, "host_ok": host_ok,
+                "windows": windows}
+
+    def _verify_exec_fused(self, p: dict) -> list[bool]:
+        """Device stage of the fused pallas RLC batch verification."""
+        n, v = p["n"], p["v"]
+        pks = jnp.asarray(p["pks"])
+        hms = jnp.asarray(p["hms"])
+        sigs, sg_ok = _sig_decompress_kernel(
+            jnp.asarray(p["sg_xc0"]), jnp.asarray(p["sg_xc1"]),
+            jnp.asarray(p["sg_sign"]), jnp.asarray(p["sg_inf"]))
+        live = p["host_ok"] & ~p["sg_bad"] & np.asarray(sg_ok)
+        live[n:] = False
         fc = jnp.asarray(pallas_g2.fold_consts())
         t1, t2, t3 = _rlc_g1_tables_kernel(pks)
-        acc = pallas_pairing.g1_scalar_mul_rows(fc, t1, t2, t3, windows)
+        acc = pallas_pairing.g1_scalar_mul_rows(fc, t1, t2, t3, p["windows"])
         p_t = _rlc_pside_kernel(acc)
-        q_t = _rlc_qside_kernel(sigs, jnp.asarray(hms))
+        q_t = _rlc_qside_kernel(sigs, hms)
         drop = np.repeat(~live, 2).reshape(-1, pallas_g2.LANES)
         prod_t = pallas_pairing.miller_product_tiled(fc, p_t, q_t,
                                                      jnp.asarray(drop))
@@ -985,9 +1085,57 @@ class TPUBackend:
             vj = _pad_pow2(n)
             ok = np.zeros(v, bool)
             ok[:vj] = np.asarray(_verify_pairing_kernel(
-                pks[:vj], sigs[:vj], jnp.asarray(hms[:vj])))
+                pks[:vj], sigs[:vj], hms[:vj]))
             ok &= live
         return [bool(b) for b in ok[:n]]
+
+    # -- startup shape prewarm ----------------------------------------------
+
+    def prewarm(self, pubshares, num_validators: int,
+                threshold: int) -> dict:
+        """Compile the production device programs at the shape buckets
+        the cluster (V, T) implies and pre-decompress every cluster
+        pubshare, so the first slot after boot never eats a cold XLA
+        compile (the seed history's cold-compile-stalls-expire-duties
+        failure mode).  Blocking — run on the dispatch launch thread.
+
+        Warmed: the verify path (configured pairing implementation +
+        hashed-message pipeline, distinct messages so the device h2c
+        bucket compiles when active) at the dispatch tile bucket
+        min(V, CHARON_TPU_DISPATCH_TILE); the threshold combine
+        (decompress + configured MSM + Lagrange digit cache) at (V, T);
+        the decompressed-pubkey cache for all `pubshares`.  Inputs are
+        ∞ signatures — always decompress-valid, no secret material
+        needed — so verdicts are discarded.  Returns a timing report."""
+        t_start = time.perf_counter()
+        v = max(1, int(num_validators))
+        t = max(1, int(threshold))
+        report: dict = {"v": v, "t": t, "pubshares": len(pubshares)}
+        if pubshares:
+            t0 = time.perf_counter()
+            self._pk_planes_cached(list(dict.fromkeys(pubshares)))
+            report["pubshare_decompress_s"] = round(
+                time.perf_counter() - t0, 4)
+        tile = dispatch.verify_tile_size()
+        nv = max(1, min(v, tile) if tile else v)
+        pk = (pubshares[0] if pubshares
+              else refcurve.g1_to_bytes(refcurve.G1_GEN))
+        inf_sig = _G2_INF_BYTES.tobytes()
+        t0 = time.perf_counter()
+        self.batch_verify_bytes(
+            [(pk, b"charon-tpu-prewarm-%d" % k, inf_sig)
+             for k in range(nv)])
+        report["verify_rows"] = nv
+        report["verify_path"] = self.verify_path(nv)
+        report["verify_s"] = round(time.perf_counter() - t0, 4)
+        idxs = tuple(range(1, t + 1))
+        t0 = time.perf_counter()
+        self.threshold_combine_bytes(
+            [{i: inf_sig for i in idxs} for _ in range(v)])
+        report["combine_path"] = self.combine_path()
+        report["combine_s"] = round(time.perf_counter() - t0, 4)
+        report["total_s"] = round(time.perf_counter() - t_start, 4)
+        return report
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,306 @@
+"""Off-loop pipelined TPU dispatch — the layer between the core services
+and the tbls backends.
+
+Problem: every device launch used to run SYNCHRONOUSLY on the asyncio
+event loop — `core/verify.BatchVerifier._flush` called
+`tbls.batch_verify` inline and `core/sigagg.SigAgg._flush` called
+`tbls.threshold_combine` inline — so a multi-hundred-ms pairing batch
+(or, worse, a cold XLA compile) froze QBFT timers, transport frames,
+slot-budget hand-offs and every concurrent duty for its full duration.
+
+This module gives the process ONE `DispatchPipeline`: a two-stage
+executor pair that owns all device work, so the core services `await`
+results without ever blocking the loop:
+
+    caller (event loop)            host-prep thread        launch thread
+    ───────────────────            ────────────────        ─────────────
+    await pipeline.batch_verify ─▶ bytes→limbs packing  ─▶ device kernels
+                                   pk/sig cache lookups    (jit'd pallas /
+                                   expand_message_xmd      jnp programs +
+                                   SHA-256 hashing         result fetch)
+
+Both stages are single-thread executors, which makes the pipeline a
+classic double buffer: while the launch thread executes batch *k*, the
+prep thread packs batch *k+1*.  Large verify batches are additionally
+TILED (``CHARON_TPU_DISPATCH_TILE``, default 2048 — the headline verify
+bucket) into pipelined sub-launches, so host prep of tile *i+1* overlaps
+device execution of tile *i* within one coalesced flush as well.
+
+The split entry points come from `tbls.api.verify_stages` /
+`combine_stages`: backends that implement the explicit host-prep /
+device-exec split (the TPU backend) pipeline for real; every other
+scheme/backend degrades to identity-prep + whole-call-exec, which still
+moves the blocking work off the event loop.
+
+Env knobs (all read per call, so tests can flip them):
+
+- ``CHARON_TPU_DISPATCH``        1 (default) off-loop pipelined dispatch;
+                                 0 = legacy inline launches (the pinned
+                                 failing baseline for the loop-lag test).
+- ``CHARON_TPU_DISPATCH_TILE``   verify entries per sub-launch tile
+                                 (default 2048; 0 disables tiling).
+- ``CHARON_TPU_DISPATCH_PREWARM`` auto (default) / 1 / 0 — compile the
+                                 production kernel programs + decompress
+                                 the cluster pubshares at boot
+                                 (`DispatchPipeline.prewarm`).
+- ``CHARON_TPU_LOOP_GUARD``      1 = device entry points raise when
+                                 invoked from the event-loop thread
+                                 (enabled by the core-service test
+                                 suites so a regression to inline
+                                 launches fails CI).
+
+This module is stdlib-only (no jax import) so the guard and knobs are
+usable from any layer without dragging the device stack in.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = [
+    "DispatchPipeline", "assert_off_loop", "default_pipeline",
+    "dispatch_enabled", "loop_guard_enabled", "prewarm_enabled",
+    "verify_tile_size",
+]
+
+
+# ---------------------------------------------------------------------------
+# Env knobs
+# ---------------------------------------------------------------------------
+
+def dispatch_enabled() -> bool:
+    """CHARON_TPU_DISPATCH: 1 (default) = off-loop pipelined dispatch,
+    0 = legacy inline launches on the caller's thread."""
+    return os.environ.get("CHARON_TPU_DISPATCH", "1") != "0"
+
+
+def verify_tile_size() -> int:
+    """CHARON_TPU_DISPATCH_TILE: verify entries per pipelined sub-launch
+    (≤ 0 disables tiling; malformed/negative values clamp to no-tiling
+    rather than risk an empty tile plan).  The default matches the
+    headline 2048-entry verify bucket, so tiling never adds a compile
+    shape the kernel contract auditor has not already checked."""
+    try:
+        return max(0, int(os.environ.get("CHARON_TPU_DISPATCH_TILE",
+                                         "2048")))
+    except ValueError:
+        return 0   # malformed knob: fail safe to no-tiling, as documented
+
+
+def prewarm_enabled() -> bool:
+    """CHARON_TPU_DISPATCH_PREWARM: auto/1 = prewarm at boot, 0 = skip."""
+    return os.environ.get("CHARON_TPU_DISPATCH_PREWARM", "auto") != "0"
+
+
+def loop_guard_enabled() -> bool:
+    return os.environ.get("CHARON_TPU_LOOP_GUARD") == "1"
+
+
+def tile_sizes(n: int, tile: int) -> list[int]:
+    """Sub-launch sizes an n-entry verify splits into at `tile` (≤ 0 =
+    no tiling).  Single source of truth for the pipeline itself AND for
+    telemetry (span attrs / per-path counters must describe the tiles
+    that actually launch, not one imaginary monolithic batch)."""
+    if tile > 0 and n > tile:
+        return [min(tile, n - i) for i in range(0, n, tile)]
+    return [n]
+
+
+def assert_off_loop(op: str) -> None:
+    """Debug guard: raise if a device entry point runs on a thread with a
+    RUNNING event loop (i.e. inline in a coroutine).  Opt-in via
+    ``CHARON_TPU_LOOP_GUARD=1`` — the core-service test suites enable it
+    as an autouse fixture, so a regression back to inline launches fails
+    CI instead of silently freezing QBFT timers in production."""
+    if not loop_guard_enabled():
+        return
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return  # executor / plain thread: exactly where launches belong
+    raise RuntimeError(
+        f"{op} invoked from the event-loop thread (CHARON_TPU_LOOP_GUARD=1)"
+        " — device work must go through tbls.dispatch.DispatchPipeline so"
+        " a multi-hundred-ms launch cannot stall QBFT timers and duty"
+        " hand-offs")
+
+
+# ---------------------------------------------------------------------------
+# The pipeline
+# ---------------------------------------------------------------------------
+
+class DispatchPipeline:
+    """Two-stage (host-prep → device-launch) executor pipeline.
+
+    Single-thread stages give strict per-stage FIFO ordering — results
+    can never be delivered to the wrong awaiter because every call holds
+    its own future chain — while still double-buffering: stage threads
+    work on DIFFERENT batches concurrently.  The busy-seconds/launch
+    counters each have a single writer thread; `queue_depth` has two
+    (submit on the loop thread, drain on the launch thread) and is
+    lock-protected.  /metrics exporters read everything racily, which
+    is fine for gauges.
+    """
+
+    def __init__(self, tile: int | None = None):
+        self._tile = tile
+        self._prep_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="charon-tpu-host-prep")
+        self._launch_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="charon-tpu-launch")
+        #: launch-stage jobs submitted but not yet finished — the
+        #: ``app_dispatch_queue_depth`` gauge.  Incremented on the
+        #: event-loop thread at submit, decremented on the launch
+        #: thread, so the read-modify-write needs the lock (a bare
+        #: ``+=`` across threads loses updates and the gauge drifts —
+        #: it feeds the EventLoopStalling alert triage).
+        self.queue_depth = 0
+        self._depth_lock = threading.Lock()
+        #: cumulative wall seconds per stage: overlap efficiency in a
+        #: window is device_busy_s delta / wall delta (bench.py A/B)
+        self.prep_busy_s = 0.0
+        self.device_busy_s = 0.0
+        self.launches = 0
+        self.prewarmed: dict | None = None
+
+    # -- stage plumbing ------------------------------------------------------
+
+    def _tile_of(self) -> int:
+        return verify_tile_size() if self._tile is None else self._tile
+
+    def _run_prep(self, fn, payload):
+        t0 = time.perf_counter()
+        try:
+            return fn(payload)
+        finally:
+            self.prep_busy_s += time.perf_counter() - t0
+
+    def _bump_depth(self, delta: int) -> None:
+        with self._depth_lock:
+            self.queue_depth += delta
+
+    def _run_launch(self, fn, prepared):
+        t0 = time.perf_counter()
+        try:
+            return fn(prepared)
+        finally:
+            self.device_busy_s += time.perf_counter() - t0
+            self.launches += 1
+            self._bump_depth(-1)
+
+    async def _pipelined(self, stages, payloads) -> list:
+        """Run each payload through (prep, exec); prep of payload *i+1*
+        overlaps the launch of payload *i*.  Returns per-payload results
+        in submission order; the FIRST stage exception is re-raised after
+        every in-flight stage has drained (a tile failure must not leave
+        orphaned executor jobs mutating shared counters mid-test)."""
+        prep_fn, exec_fn = stages
+        loop = asyncio.get_running_loop()
+        launch_futs = []
+        prep_exc: BaseException | None = None
+        for payload in payloads:
+            try:
+                prepared = await loop.run_in_executor(
+                    self._prep_pool, self._run_prep, prep_fn, payload)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                prep_exc = exc
+                break
+            self._bump_depth(+1)
+            launch_futs.append(loop.run_in_executor(
+                self._launch_pool, self._run_launch, exec_fn, prepared))
+        results = await asyncio.gather(*launch_futs, return_exceptions=True)
+        if prep_exc is not None:
+            raise prep_exc
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return list(results)
+
+    # -- public --------------------------------------------------------------
+
+    def plan_verify(self, n: int) -> list[int]:
+        """The sub-launch sizes an n-entry verify will run as right now
+        (telemetry callers attribute paths/padding per tile)."""
+        return tile_sizes(n, self._tile_of())
+
+    async def batch_verify(self, entries) -> list:
+        """`tbls.batch_verify` off-loop, tiled into pipelined
+        sub-launches when the batch exceeds the tile size."""
+        from . import api
+
+        n = len(entries)
+        if n == 0:
+            return []
+        # tile_sizes never returns an empty plan (tile ≤ 0 → one
+        # whole-batch launch): an empty plan would resolve every awaiter
+        # with zero verdicts and fail OPEN at `all([])` call-sites
+        payloads, pos = [], 0
+        for size in self.plan_verify(n):
+            payloads.append(entries[pos:pos + size])
+            pos += size
+        per_tile = await self._pipelined(api.verify_stages(), payloads)
+        return [ok for part in per_tile for ok in part]
+
+    async def threshold_combine(self, batch) -> list:
+        """`tbls.threshold_combine` off-loop: host packing (Lagrange
+        digit lookups, byte shuffling) on the prep thread, the MSM
+        launch on the launch thread."""
+        from . import api
+
+        if not batch:
+            return []
+        [out] = await self._pipelined(api.combine_stages(), [batch])
+        return out
+
+    async def prewarm(self, pubshares, num_validators: int,
+                      threshold: int) -> dict:
+        """Boot-time shape prewarm: compile the production kernel
+        programs at the pow2 buckets implied by the cluster (V, T) and
+        pre-decompress all cluster pubshares, so the first slot never
+        eats a cold XLA compile (the seed history's
+        cold-compile-stalls-expire-duties failure mode).
+
+        Runs on its OWN short-lived thread, NOT the launch pool: a
+        multi-second compile job queued on the single launch thread
+        would head-of-line-block the first duties' launches behind the
+        whole prewarm — strictly worse than no prewarm.  Off the pool,
+        real launches proceed immediately and only contend on jax's
+        internal per-program compile locks for shapes they actually
+        share (in which case the duty simply finishes the compile it
+        needed anyway)."""
+        from . import api
+
+        loop = asyncio.get_running_loop()
+        pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="charon-tpu-prewarm")
+        try:
+            report = await loop.run_in_executor(
+                pool, api.prewarm, pubshares, num_validators, threshold)
+        finally:
+            pool.shutdown(wait=False)
+        self.prewarmed = report
+        return report
+
+    def shutdown(self) -> None:
+        """Tests only — the process-default pipeline lives for the
+        process, like the jax runtime it fronts."""
+        self._prep_pool.shutdown(wait=True)
+        self._launch_pool.shutdown(wait=True)
+
+
+_default: DispatchPipeline | None = None
+
+
+def default_pipeline() -> DispatchPipeline | None:
+    """The process-wide pipeline (lazily created), or None when
+    ``CHARON_TPU_DISPATCH=0`` pins the legacy inline behaviour."""
+    global _default
+    if not dispatch_enabled():
+        return None
+    if _default is None:
+        _default = DispatchPipeline()
+    return _default

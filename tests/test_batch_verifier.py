@@ -27,6 +27,16 @@ def insecure_scheme():
     tbls.set_scheme("bls")
 
 
+@pytest.fixture(autouse=True)
+def loop_guard(monkeypatch):
+    """Core-service suites run with the debug loop guard armed
+    (CHARON_TPU_LOOP_GUARD=1): a regression of BatchVerifier back to
+    inline on-loop tbls launches fails here instead of silently
+    freezing the duty pipeline in production."""
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    yield
+
+
 @pytest.fixture
 def counted_batch_verify(monkeypatch):
     """Wrap tbls.batch_verify with a launch counter (the BatchVerifier

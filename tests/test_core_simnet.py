@@ -41,6 +41,15 @@ def insecure_scheme():
     tbls.set_scheme("bls")
 
 
+@pytest.fixture(autouse=True)
+def loop_guard(monkeypatch):
+    """Armed loop guard (CHARON_TPU_LOOP_GUARD=1): any core component
+    regressing to an inline on-loop tbls.batch_verify /
+    threshold_combine launch fails the whole simnet suite."""
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    yield
+
+
 def build_cluster(consensus_factory=None):
     cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
     bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)

@@ -1,0 +1,525 @@
+"""Off-loop pipelined TPU dispatch (tbls/dispatch.py).
+
+The tentpole contract: device launches NEVER run on the asyncio event
+loop.  `BatchVerifier`/`SigAgg` await a `DispatchPipeline` whose
+host-prep and launch executor threads double-buffer batches, so a
+multi-hundred-ms pairing launch (or cold XLA compile) cannot freeze
+QBFT timers, transport frames or slot-budget hand-offs — the failure
+mode this suite pins with a fake slow backend:
+
+- the acceptance e2e: with a verify launch stretched to ≥ 500 ms, a
+  4-process QBFT cluster decides and slot-budget hand-offs complete
+  WHILE the launch is in flight, and loop-lag p99 stays < 50 ms
+  (the inline baseline is pinned as a skipped regression test below);
+- pipeline ordering: verdicts map to the right awaiters under
+  concurrent flushes and under tiled sub-launches; a tile exception
+  fails only its own flush batch and the pipeline stays serviceable;
+- the debug loop guard (CHARON_TPU_LOOP_GUARD=1, armed suite-wide here
+  and in the core-service suites): inline on-loop `tbls.batch_verify` /
+  `threshold_combine` calls raise instead of silently blocking;
+- differential: pipelined verdicts are identical to inline ones
+  (insecure scheme in the fast lane; real BLS vs the CPU-backend oracle
+  through the TPU backend in the slow lane), corrupted rows included;
+- startup prewarm: report shape + pubshare-cache seeding.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from charon_tpu.core import qbft
+from charon_tpu.core.slotbudget import SlotBudget
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.core.verify import BatchVerifier
+from charon_tpu.tbls import api as tbls
+from charon_tpu.tbls import dispatch
+
+
+@pytest.fixture(autouse=True)
+def loop_guard(monkeypatch):
+    """Every test here runs with the debug loop guard ARMED: any
+    regression back to inline on-loop device entry points fails."""
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    yield
+
+
+@pytest.fixture(autouse=True)
+def insecure_scheme():
+    tbls.set_scheme("insecure-test")
+    yield
+    tbls.set_scheme("bls")
+
+
+def _keypair(tag: bytes):
+    sk = tag.ljust(32, b"\0")
+    return sk, tbls.privkey_to_pubkey(sk)
+
+
+# ---------------------------------------------------------------------------
+# Loop guard
+# ---------------------------------------------------------------------------
+
+def test_loop_guard_blocks_inline_on_loop_calls():
+    """With the guard armed, the blocking tbls entry points raise when
+    invoked from the event-loop thread and pass anywhere else."""
+    sk, pk = _keypair(b"\x01")
+    entries = [(pk, b"m", tbls.sign(sk, b"m"))]
+
+    async def inline_verify():
+        return tbls.batch_verify(entries)
+
+    async def inline_combine():
+        return tbls.threshold_combine([{1: b"\x00" * 96, 2: b"\x01" * 96}])
+
+    with pytest.raises(RuntimeError, match="event-loop thread"):
+        asyncio.run(inline_verify())
+    with pytest.raises(RuntimeError, match="event-loop thread"):
+        asyncio.run(inline_combine())
+    # no running loop on this thread: the same calls are fine
+    assert tbls.batch_verify(entries) == [True]
+    assert len(tbls.threshold_combine([{1: b"\x00" * 96,
+                                        2: b"\x01" * 96}])) == 1
+
+
+def test_negative_tile_knob_cannot_fail_open(monkeypatch):
+    """A malformed/negative CHARON_TPU_DISPATCH_TILE must clamp to
+    no-tiling, not produce an EMPTY tile plan — zero verdicts would
+    fail OPEN at `all(await verify_many(...))` call-sites."""
+    sk, pk = _keypair(b"\x03")
+    entries = [(pk, b"m", tbls.sign(sk, b"m")),
+               (pk, b"x", tbls.sign(sk, b"other"))]
+    for bad in ("-1", "not-a-number"):
+        monkeypatch.setenv("CHARON_TPU_DISPATCH_TILE", bad)
+        assert dispatch.verify_tile_size() >= 0
+        pipe = dispatch.DispatchPipeline()
+        try:
+            assert asyncio.run(pipe.batch_verify(entries)) == [True, False]
+        finally:
+            pipe.shutdown()
+
+
+def test_dispatch_knob_pins_legacy_inline(monkeypatch):
+    """CHARON_TPU_DISPATCH=0 restores the seed's inline launches — which
+    is exactly the regression the armed guard turns into an error, so
+    the knob and the guard cross-check each other."""
+    monkeypatch.setenv("CHARON_TPU_DISPATCH", "0")
+    assert dispatch.default_pipeline() is None
+    v = BatchVerifier()
+    sk, pk = _keypair(b"\x02")
+    with pytest.raises(RuntimeError, match="event-loop thread"):
+        asyncio.run(v.verify(pk, b"m", tbls.sign(sk, b"m")))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline ordering
+# ---------------------------------------------------------------------------
+
+def test_verifier_coalesces_through_pipeline(monkeypatch):
+    """The off-loop pipeline preserves the tick-coalescing contract:
+    N concurrent verifies → ONE tbls.batch_verify call, verdicts in
+    order — now executed on the launch thread."""
+    calls = []
+    orig = tbls.batch_verify
+
+    def counting(entries):
+        calls.append(len(entries))
+        return orig(entries)
+
+    monkeypatch.setattr(tbls, "batch_verify", counting)
+    v = BatchVerifier()
+    n = 12
+    pairs = [_keypair(bytes([i + 1])) for i in range(n)]
+
+    async def main():
+        return await asyncio.gather(*[
+            v.verify(pk, bytes([i]), tbls.sign(sk, bytes([i])))
+            for i, (sk, pk) in enumerate(pairs)])
+
+    assert asyncio.run(main()) == [True] * n
+    assert v.launches == 1
+    assert calls == [n]
+
+
+def test_tiled_subflush_preserves_order(monkeypatch):
+    """A flush above the dispatch tile splits into pipelined sub-launches
+    whose verdicts re-concatenate in entry order."""
+    calls = []
+    orig = tbls.batch_verify
+
+    def counting(entries):
+        calls.append(len(entries))
+        return orig(entries)
+
+    monkeypatch.setattr(tbls, "batch_verify", counting)
+    pipe = dispatch.DispatchPipeline(tile=2)
+    v = BatchVerifier(dispatcher=pipe)
+    sk, pk = _keypair(b"\x07")
+    entries, want = [], []
+    for i in range(5):
+        good = i != 3
+        sig = tbls.sign(sk, b"ok-%d" % i if good else b"other")
+        entries.append((pk, b"ok-%d" % i, sig))
+        want.append(good)
+    try:
+        assert asyncio.run(v.verify_many(entries)) == want
+    finally:
+        pipe.shutdown()
+    assert calls == [2, 2, 1]           # 5 entries → tiles of 2/2/1
+    assert v.launches == 1              # still ONE coalesced launch unit
+    assert v.max_batch == 5
+
+
+def test_concurrent_flushes_map_results_to_right_awaiters():
+    """Several flush units in flight (single launch thread → they queue)
+    each resolve with exactly their own verdict slice, and a combine
+    interleaves with verifies through the same pipeline."""
+    tss, shares = tbls.generate_tss(2, 3, seed=b"dispatch-order")
+    msg = b"duty-root"
+    partials = {i: tbls.partial_sign(s, msg) for i, s in shares.items()}
+
+    sk_a, pk_a = _keypair(b"\x0a")
+    sk_b, pk_b = _keypair(b"\x0b")
+
+    async def main():
+        pipe = dispatch.default_pipeline()
+        u1 = asyncio.ensure_future(pipe.batch_verify(
+            [(pk_a, b"a1", tbls.sign(sk_a, b"a1")),
+             (pk_a, b"a2", tbls.sign(sk_a, b"wrong"))]))
+        u2 = asyncio.ensure_future(pipe.threshold_combine(
+            [{i: partials[i] for i in (1, 3)}]))
+        u3 = asyncio.ensure_future(pipe.batch_verify(
+            [(pk_b, b"b1", tbls.sign(sk_b, b"b1"))]))
+        r1, (group_sig,), r3 = await asyncio.gather(u1, u2, u3)
+        # the combined group signature round-trips through a verify
+        ok = await pipe.batch_verify([(tss.group_pubkey, msg, group_sig)])
+        return r1, r3, ok
+
+    r1, r3, ok = asyncio.run(main())
+    assert r1 == [True, False]
+    assert r3 == [True]
+    assert ok == [True]
+
+
+def test_tile_exception_fails_only_its_flush_batch(monkeypatch):
+    """An exception inside one launch (here: one tile of the second
+    flush) rejects only that flush's awaiters; a concurrent in-flight
+    flush and later flushes are unaffected."""
+    orig = tbls.batch_verify
+
+    def faulty(entries):
+        if any(msg == b"boom" for _, msg, _ in entries):
+            raise RuntimeError("tile fault")
+        if any(msg == b"slow" for _, msg, _ in entries):
+            time.sleep(0.15)      # hold the launch thread: overlap is real
+        return orig(entries)
+
+    monkeypatch.setattr(tbls, "batch_verify", faulty)
+    pipe = dispatch.DispatchPipeline(tile=2)
+    v = BatchVerifier(dispatcher=pipe)
+    sk, pk = _keypair(b"\x0c")
+
+    def sig(m):
+        return tbls.sign(sk, m)
+
+    async def main():
+        t1 = asyncio.create_task(v.verify_many(
+            [(pk, b"slow", sig(b"slow")), (pk, b"g1", sig(b"g1"))]))
+        await asyncio.sleep(0.05)         # t1's launch is now in flight
+        t2 = asyncio.create_task(v.verify_many(
+            [(pk, b"g2", sig(b"g2")), (pk, b"g3", sig(b"g3")),
+             (pk, b"boom", sig(b"x"))]))
+        r1 = await t1
+        with pytest.raises(RuntimeError, match="tile fault"):
+            await t2
+        # the pipeline and verifier stay serviceable after the fault
+        r3 = await v.verify(pk, b"after", sig(b"after"))
+        return r1, r3
+
+    try:
+        r1, r3 = asyncio.run(main())
+    finally:
+        pipe.shutdown()
+    assert r1 == [True, True]
+    assert r3 is True
+
+
+# ---------------------------------------------------------------------------
+# Differential: pipelined verdicts ≡ inline verdicts
+# ---------------------------------------------------------------------------
+
+def test_pipelined_verdicts_match_inline_both_tile_settings():
+    """Accept/reject through the pipelined path is identical to the
+    inline path for every entry — valid, corrupted signature, wrong key
+    and malformed pubkey rows — untiled and tiled."""
+    sk1, pk1 = _keypair(b"\x11")
+    sk2, pk2 = _keypair(b"\x12")
+    entries = [
+        (pk1, b"m1", tbls.sign(sk1, b"m1")),
+        (pk2, b"m2", tbls.sign(sk2, b"m2")),
+        (pk1, b"m3", tbls.sign(sk1, b"corrupted")),   # corrupted row
+        (pk2, b"m1", tbls.sign(sk1, b"m1")),          # wrong key
+        (b"\x00" * 48, b"m1", tbls.sign(sk1, b"m1")),  # malformed pk
+    ]
+    inline = tbls.batch_verify(entries)   # no loop on this thread
+    assert inline == [True, True, False, False, False]
+    for tile in (0, 2):
+        pipe = dispatch.DispatchPipeline(tile=tile)
+        try:
+            assert asyncio.run(pipe.batch_verify(entries)) == inline, \
+                f"tile={tile}"
+        finally:
+            pipe.shutdown()
+
+
+@pytest.mark.slow
+def test_pipeline_differential_real_bls_vs_cpu_oracle():
+    """Round-10 acceptance: real-BLS verdicts through the PIPELINED
+    TPU-backend path are bit-identical to the CPU-backend oracle on both
+    knob settings (pipelined untiled + tiled sub-launches vs inline),
+    corrupted-row and wrong-key rows included; ditto the combine."""
+    from charon_tpu.tbls import shamir
+    from charon_tpu.tbls.ref import bls, curve as refcurve
+    from charon_tpu.tbls.ref.hash_to_curve import hash_to_g2
+
+    tbls.set_scheme("bls")
+    msgs = [b"disp-oracle-%d" % i for i in range(8)]
+    sks = [5353 + i for i in range(8)]
+    entries = []
+    for sk, m in zip(sks, msgs):
+        entries.append((refcurve.g1_to_bytes(bls.sk_to_pk(sk)), m,
+                        refcurve.g2_to_bytes(bls.sign(sk, m))))
+    entries[3] = (entries[3][0], b"disp-oracle-corrupted", entries[3][2])
+    entries[6] = (entries[0][0], entries[6][1], entries[6][2])  # wrong key
+    tbls.set_backend("cpu")
+    oracle = tbls.batch_verify(entries)
+    assert oracle == [True, True, True, False, True, True, False, True]
+
+    # combine: 3 validators, mixed share sets (test_tbls_backend shapes)
+    msg = b"disp-combine"
+    batch, expected = [], []
+    for v, (t, n, idxs) in enumerate([(2, 3, (1, 3)), (3, 4, (2, 3, 4)),
+                                      (2, 2, (1, 2))]):
+        sk = 911 + v
+        shares, _ = shamir.split_secret(sk, t, n)
+        hm = hash_to_g2(msg)
+        parts = {i: refcurve.g2_to_bytes(refcurve.multiply(hm, s))
+                 for i, s in shares.items()}
+        batch.append({i: parts[i] for i in idxs})
+        expected.append(refcurve.g2_to_bytes(bls.sign(sk, msg)))
+
+    tbls.set_backend("tpu")
+    try:
+        assert tbls.batch_verify(entries) == oracle   # inline knob
+        for tile in (0, 4):                           # pipelined knob
+            pipe = dispatch.DispatchPipeline(tile=tile)
+            try:
+                assert asyncio.run(pipe.batch_verify(entries)) == oracle, \
+                    f"tile={tile}"
+                assert asyncio.run(
+                    pipe.threshold_combine(batch)) == expected
+            finally:
+                pipe.shutdown()
+    finally:
+        tbls.set_backend("cpu")
+
+
+# ---------------------------------------------------------------------------
+# Startup prewarm
+# ---------------------------------------------------------------------------
+
+def test_prewarm_skips_without_device_programs():
+    assert "skipped" in tbls.prewarm([], 4, 2)        # insecure scheme
+    tbls.set_scheme("bls")                            # cpu backend
+    assert "skipped" in tbls.prewarm([], 4, 2)
+
+    async def through_pipeline():
+        pipe = dispatch.DispatchPipeline()
+        try:
+            return await pipe.prewarm([], 4, 2)
+        finally:
+            pipe.shutdown()
+
+    tbls.set_scheme("insecure-test")
+    report = asyncio.run(through_pipeline())
+    assert "skipped" in report
+
+
+@pytest.mark.slow
+def test_prewarm_tpu_backend_compiles_and_seeds_caches(monkeypatch):
+    """TPU-backend prewarm runs the real verify + combine programs at
+    the cluster's shape buckets and seeds the decompressed-pubkey
+    cache, so the first duty pays no cold compile."""
+    from charon_tpu.tbls import backend_tpu
+    from charon_tpu.tbls.ref import bls, curve as refcurve
+
+    tbls.set_scheme("bls")
+    tbls.set_backend("tpu")
+    monkeypatch.setenv("CHARON_TPU_DISPATCH_TILE", "4")
+    pk = refcurve.g1_to_bytes(bls.sk_to_pk(24680))
+    try:
+        report = tbls.prewarm([pk], num_validators=3, threshold=3)
+    finally:
+        tbls.set_backend("cpu")
+    assert report["verify_rows"] == 3           # min(V, tile)
+    assert report["v"] == 3 and report["t"] == 3
+    assert report["total_s"] >= report["combine_s"]
+    assert pk in backend_tpu.TPUBackend._PK_CACHE
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e: loop responsiveness under a slow launch
+# ---------------------------------------------------------------------------
+
+class _QBFTNet:
+    """In-memory broadcast network (tests/test_qbft.py pattern)."""
+
+    def __init__(self, n: int):
+        self.queues = {p: asyncio.Queue() for p in range(n)}
+
+    def transport(self, process: int) -> qbft.Transport:
+        async def broadcast(msg):
+            for q in self.queues.values():
+                await q.put(msg)
+
+        return qbft.Transport(broadcast, self.queues[process])
+
+
+async def _decide_qbft_cluster(n: int = 4, run_for: float = 3.0) -> dict:
+    """Run an n-process QBFT instance to decision; returns
+    {task_name: decided value}."""
+    decided = {}
+
+    async def decide(instance, value, justification):
+        decided.setdefault(asyncio.current_task().get_name(), value)
+
+    d = qbft.Definition(
+        is_leader=lambda inst, rnd, proc: (rnd - 1) % n == proc,
+        round_timeout=lambda rnd: 0.2 * (1 + rnd),
+        nodes=n, decide=decide)
+    net = _QBFTNet(n)
+    loop = asyncio.get_running_loop()
+    tasks = [loop.create_task(
+        qbft.run(d, net.transport(p), "inst-slow", p, f"v{p}"),
+        name=f"proc-{p}") for p in range(n)]
+    deadline = loop.time() + run_for
+    while loop.time() < deadline and len(decided) < n:
+        await asyncio.sleep(0.01)
+    for t in tasks:
+        t.cancel()
+    await asyncio.sleep(0)
+    return decided
+
+
+async def _drive_slot_budget_handoffs(sb: SlotBudget, duty: Duty) -> dict:
+    await sb.on_duty_scheduled(duty, None)
+    await sb.on_fetched(duty, None)
+    await sb.on_consensus(duty, None)
+    await sb.on_threshold(duty, None, None)
+    await sb.on_aggregated(duty, None, None)
+    await sb.on_broadcast(duty, None, None)
+    return sb.finalize(duty)
+
+
+def test_slow_launch_keeps_loop_responsive(monkeypatch):
+    """Acceptance (round 10): with a verify launch artificially
+    stretched to ≥ 500 ms, QBFT message processing and slot-budget
+    hand-offs CONTINUE while the launch is in flight, and the event
+    loop's self-probed lag p99 stays < 50 ms.  The same scenario
+    without the pipeline is pinned as the skipped failing baseline in
+    `test_inline_dispatch_freezes_loop_baseline` below."""
+    from charon_tpu.app.monitoring import Registry, loop_lag_probe
+
+    orig = tbls.batch_verify
+
+    def slow(entries):
+        time.sleep(0.6)   # blocking device-launch stand-in (≥ 500 ms)
+        return orig(entries)
+
+    monkeypatch.setattr(tbls, "batch_verify", slow)
+    registry = Registry()
+    lags: list[float] = []
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        pipe = dispatch.default_pipeline()
+        probe = asyncio.ensure_future(
+            loop_lag_probe(registry, interval=0.01, dispatcher=pipe))
+
+        async def sampler():     # raw lag samples for the p99 assert
+            while True:
+                t0 = loop.time()
+                await asyncio.sleep(0.01)
+                lags.append(max(0.0, loop.time() - t0 - 0.01))
+
+        s = asyncio.ensure_future(sampler())
+        v = BatchVerifier(dispatcher=pipe)
+        sk, pk = _keypair(b"\x21")
+        t_verify = asyncio.ensure_future(
+            v.verify(pk, b"duty", tbls.sign(sk, b"duty")))
+        await asyncio.sleep(0.05)
+        assert not t_verify.done(), "launch should be in flight"
+        depth_seen = pipe.queue_depth
+        # QBFT decides AND slot-budget hand-offs complete mid-launch
+        decided = await _decide_qbft_cluster()
+        phases = await _drive_slot_budget_handoffs(
+            SlotBudget(), Duty(7, DutyType.ATTESTER))
+        in_flight = not t_verify.done()
+        ok = await t_verify
+        probe.cancel()
+        s.cancel()
+        return decided, phases, in_flight, ok, depth_seen
+
+    decided, phases, in_flight, ok, depth_seen = asyncio.run(main())
+    assert ok is True
+    assert depth_seen >= 1                     # the launch was queued
+    assert len(decided) == 4 and set(decided.values()) == {"v0"}, \
+        "QBFT must decide while the verify launch is in flight"
+    assert in_flight, "QBFT decision must land before the 600 ms launch"
+    assert phases is not None and set(phases) >= {"scheduler", "bcast"}
+    lags.sort()
+    p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))]
+    assert p99 < 0.05, f"loop-lag p99 {p99 * 1e3:.1f} ms ≥ 50 ms"
+    rendered = registry.render()
+    assert "app_event_loop_lag_seconds_bucket" in rendered
+    assert "app_dispatch_queue_depth" in rendered
+
+
+@pytest.mark.skip(reason=(
+    "pinned FAILING baseline: with CHARON_TPU_DISPATCH=0 the verify "
+    "launch runs inline on the event loop, so for its full 600 ms no "
+    "QBFT message is processed, no slot-budget hand-off fires, and the "
+    "loop-lag probe records one ~600 ms sample — p99 ≈ the launch time, "
+    "12× the 50 ms bar.  Kept runnable as documentation of the failure "
+    "mode the dispatch pipeline removes."))
+def test_inline_dispatch_freezes_loop_baseline(monkeypatch):
+    orig = tbls.batch_verify
+
+    def slow(entries):
+        time.sleep(0.6)
+        return orig(entries)
+
+    monkeypatch.setattr(tbls, "batch_verify", slow)
+    monkeypatch.setenv("CHARON_TPU_DISPATCH", "0")
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "0")  # guard would catch it
+    lags: list[float] = []
+
+    async def main():
+        loop = asyncio.get_running_loop()
+
+        async def sampler():
+            while True:
+                t0 = loop.time()
+                await asyncio.sleep(0.01)
+                lags.append(max(0.0, loop.time() - t0 - 0.01))
+
+        s = asyncio.ensure_future(sampler())
+        v = BatchVerifier()
+        sk, pk = _keypair(b"\x22")
+        ok = await v.verify(pk, b"duty", tbls.sign(sk, b"duty"))
+        s.cancel()
+        return ok
+
+    assert asyncio.run(main()) is True
+    # the freeze: a single lag sample swallowed the whole launch
+    assert max(lags) >= 0.5, "inline launch should have frozen the loop"

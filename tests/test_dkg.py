@@ -225,3 +225,53 @@ def test_equivocating_dealer_detected(tmp_path):
                 await mesh.stop()
 
     asyncio.run(main())
+
+
+def test_sign_and_aggregate_batched_combine_off_loop(monkeypatch):
+    """Round 10: the ceremony's lock/deposit threshold combines run as
+    ONE batched launch awaited OFF the event loop (dispatch pipeline) —
+    pinned with the loop guard armed, no TCP mesh needed (n=1), and the
+    lock/deposit row interleave checked per validator."""
+    import asyncio
+
+    from charon_tpu.cluster.definition import Definition, Operator
+    from charon_tpu.dkg import keygen
+    from charon_tpu.dkg.ceremony import Ceremony
+    from charon_tpu.eth2util import deposit as deposit_mod
+    from charon_tpu.tbls import api as tbls
+
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    tbls.set_scheme("insecure-test")
+    try:
+        class _StubMesh:
+            peers = []
+
+            def register_handler(self, *a, **k):
+                pass
+
+            async def send_async(self, *a, **k):
+                pass
+
+        d = Definition(name="x", operators=(Operator(address="0xstub"),),
+                       threshold=1, num_validators=2,
+                       fork_version=b"\x00" * 4)
+        cer = Ceremony(d, _StubMesh(), 0, b"\x00" * 32)
+        results = []
+        for v in range(2):
+            sk = bytes([v + 1]).ljust(32, b"\0")
+            pk = tbls.privkey_to_pubkey(sk)
+            results.append(keygen.KeygenResult(
+                group_pubkey=pk, secret_share=sk, pubshares={1: pk}))
+
+        lock, deposits = asyncio.run(
+            cer.sign_and_aggregate(results, b"\x01" * 32))
+        assert len(deposits) == 2
+        assert len(lock.signature_aggregate) == 2 * 96
+        for v, r in enumerate(results):
+            droot = deposit_mod.deposit_signing_root(
+                r.group_pubkey, b"\x01" * 32, d.fork_version)
+            assert tbls.verify(r.group_pubkey, droot,
+                               deposits[v].signature), \
+                "deposit row misaligned with validator"
+    finally:
+        tbls.set_scheme("bls")

@@ -63,6 +63,14 @@ def insecure_scheme():
     tbls.set_scheme("bls")
 
 
+@pytest.fixture(autouse=True)
+def loop_guard(monkeypatch):
+    """Armed loop guard (CHARON_TPU_LOOP_GUARD=1): observability e2e
+    nodes must never launch device work inline on the event loop."""
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    yield
+
+
 def build_observable_cluster(tmp_path):
     cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
     bmock = BeaconMock(slot_duration=SLOT_DUR, slots_per_epoch=SPE)

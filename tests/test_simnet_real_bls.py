@@ -42,6 +42,15 @@ def real_bls_tpu_backend():
     tbls.set_backend("cpu")
 
 
+@pytest.fixture(autouse=True)
+def loop_guard(monkeypatch):
+    """Armed loop guard: the real-BLS duty pipeline must reach the TPU
+    backend only through the off-loop dispatch pipeline — an inline
+    on-loop device launch fails this suite."""
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    yield
+
+
 def test_simnet_real_bls_attestation_on_device_backend():
     cluster = new_cluster_for_test(THRESHOLD, N_NODES, N_VALS)
 
