@@ -26,7 +26,8 @@ def main(argv=None) -> int:
         description="Trace-time kernel contract auditor (no TPU needed)")
     ap.add_argument("--golden-bad",
                     choices=["r05_vmem", "replicated_carry", "float_leak",
-                             "bad_buckets", "unbounded_label"],
+                             "bad_buckets", "unbounded_label",
+                             "resident_roundtrip"],
                     help="audit a known-broken fixture instead of HEAD "
                          "(expected exit status: non-zero)")
     ap.add_argument("--trace", default="all",

@@ -69,6 +69,7 @@ class KernelAudit:
 class AuditReport:
     kernels: list = field(default_factory=list)
     shard_cases: list = field(default_factory=list)
+    residency_cases: list = field(default_factory=list)
     shapes_checked: list = field(default_factory=list)
     metrics_lint: object = None  # metrics_lint.MetricsLintReport | None
 
@@ -79,6 +80,8 @@ class AuditReport:
             out += k.violations
         for s in self.shard_cases:
             out += s.violations
+        for r in self.residency_cases:
+            out += r.violations
         if self.metrics_lint is not None:
             out += self.metrics_lint.violations
         return out
@@ -93,6 +96,7 @@ class AuditReport:
             "shapes_checked": self.shapes_checked,
             "kernels": [asdict(k) for k in self.kernels],
             "shard_cases": [asdict(s) for s in self.shard_cases],
+            "residency_cases": [asdict(r) for r in self.residency_cases],
             "metrics_lint": (self.metrics_lint.to_dict()
                              if self.metrics_lint is not None else None),
             "violations": self.violations,
@@ -117,6 +121,12 @@ class AuditReport:
             verdict = "ok" if not s.violations else "FAIL"
             lines.append(f"  [{verdict}] {s.name}: "
                          f"{s.carries_checked} loop carries checked")
+        for r in self.residency_cases:
+            verdict = "ok" if not r.violations else "FAIL"
+            traced = (f"{r.eqns} eqns, {r.trace_seconds:.1f}s"
+                      if r.eqns is not None else "trace failed")
+            lines.append(f"  [{verdict}] {r.name}: resident end-to-end "
+                         f"({traced}, {len(r.stages)} stages)")
         if self.metrics_lint is not None:
             lines.append(self.metrics_lint.summary())
         for v in self.violations:
@@ -271,7 +281,8 @@ def _shape_s_rows(family: str, shapes=None):
 def run_audit(shapes=None, trace: str = "all", shard: bool = True,
               n_dev: int | None = None, tolerance=None,
               shard_retrace: bool = True,
-              metrics: bool = True) -> AuditReport:
+              metrics: bool = True,
+              residency: bool | None = None) -> AuditReport:
     """Run the kernel contract audit.
 
     shapes : optional [(V, T), ...] overriding the registered workload
@@ -285,6 +296,12 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
              checking on (see shard_audit.audit_shard_case).
     metrics : run the metric-name lint over the package source (pure
              AST, sub-second — on in every audit surface).
+    residency : run the residency pass over the registered fused
+             dispatch graphs (each graph traces once, seconds under the
+             DIRECT forms).  Default: on when the verify-path kernels
+             are being traced (trace "all"/"pairing") — the fast
+             straus-only lanes skip it, the full audit and the
+             pairing-active bench preflight pay it.
     """
     registry.ensure_populated()
     report = AuditReport()
@@ -330,6 +347,12 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
     if shard:
         report.shard_cases += run_shard_audit(n_dev=n_dev,
                                               retrace=shard_retrace)
+    if residency is None:
+        residency = trace in ("all", "pairing")
+    if residency:
+        from .residency import run_residency_audit
+
+        report.residency_cases += run_residency_audit()
     return report
 
 

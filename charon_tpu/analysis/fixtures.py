@@ -180,6 +180,34 @@ reg.observe("app_fixture_seconds", 0.1,
 '''
 
 
+def resident_roundtrip_spec() -> registry.ResidencyProgramSpec:
+    """The residency-pass golden-bad: a fused-graph builder that fetches
+    an intermediate back to the host (``np.asarray`` on the traced
+    value) between its two registered stages — exactly the per-stage
+    fetch/re-upload seam the round-12 resident verify graph exists to
+    eliminate.  The residency pass must fail the trace."""
+
+    def build(kind: str, v: int):
+        import jax.numpy as jnp
+
+        def graph(x):
+            y = x * 2                       # stage "scale"
+            host = np.asarray(y)            # THE BUG: device→host fetch
+            return jnp.asarray(host) + 1    # stage "offset" (re-upload)
+
+        return graph
+
+    def make_args(kind: str, v: int) -> tuple:
+        import jax
+
+        return (jax.ShapeDtypeStruct((v, 32), np.int32),)
+
+    return registry.ResidencyProgramSpec(
+        name="golden_bad.resident_roundtrip", build=build,
+        make_args=make_args, stages=("scale", "offset"),
+        cases=(("jnp", 8),))
+
+
 def lint_golden_bad(which: str):
     """Run the metrics lint over one known-bad source fixture."""
     from .metrics_lint import lint_sources
@@ -207,6 +235,13 @@ def audit_golden_bad(which: str):
     elif which == "float_leak":
         report.kernels.append(
             audit_kernel(float_leak_kernel_spec(), [8], trace=True))
+    elif which == "resident_roundtrip":
+        from .residency import audit_residency_case
+
+        spec = resident_roundtrip_spec()
+        for case in spec.cases:
+            report.residency_cases.append(
+                audit_residency_case(spec, *case))
     elif which == "replicated_carry":
         from .audit import shard_audit_env
         from .shard_audit import audit_shard_case

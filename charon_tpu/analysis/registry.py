@@ -59,6 +59,26 @@ class WorkloadShape:
 
 
 @dataclass(frozen=True)
+class ResidencyProgramSpec:
+    """One fused dispatch graph whose device residency the auditor
+    checks (charon_tpu.analysis.residency).
+
+    ``build(kind, v)`` returns the UN-JITTED end-to-end graph callable
+    for one flavor/bucket; ``make_args(kind, v)`` the matching
+    ``jax.ShapeDtypeStruct`` args.  ``stages`` documents the fused
+    stage boundaries in dataflow order — the pass asserts the whole
+    chain traces into ONE jaxpr (a host round-trip between stages
+    either fails the trace or appears as a callback/infeed primitive).
+    ``cases`` lists the (kind, v) instantiations to audit."""
+
+    name: str
+    build: Callable[..., Callable[..., Any]]
+    make_args: Callable[..., tuple]
+    stages: tuple = ()
+    cases: tuple = ()
+
+
+@dataclass(frozen=True)
 class ShardProgramSpec:
     """One shard_map program family of the backend.
 
@@ -77,6 +97,7 @@ class ShardProgramSpec:
 _KERNELS: dict[str, KernelSpec] = {}
 _SHAPES: dict[tuple, WorkloadShape] = {}
 _SHARD_PROGRAMS: dict[str, ShardProgramSpec] = {}
+_RESIDENCY_PROGRAMS: dict[str, ResidencyProgramSpec] = {}
 
 
 def register_kernel(spec: KernelSpec) -> None:
@@ -91,6 +112,10 @@ def register_shard_program(spec: ShardProgramSpec) -> None:
     _SHARD_PROGRAMS[spec.name] = spec
 
 
+def register_residency_program(spec: ResidencyProgramSpec) -> None:
+    _RESIDENCY_PROGRAMS[spec.name] = spec
+
+
 def kernels() -> tuple[KernelSpec, ...]:
     return tuple(_KERNELS[k] for k in sorted(_KERNELS))
 
@@ -102,6 +127,10 @@ def workload_shapes(family: str | None = None) -> tuple[WorkloadShape, ...]:
 
 def shard_programs() -> tuple[ShardProgramSpec, ...]:
     return tuple(_SHARD_PROGRAMS[k] for k in sorted(_SHARD_PROGRAMS))
+
+
+def residency_programs() -> tuple[ResidencyProgramSpec, ...]:
+    return tuple(_RESIDENCY_PROGRAMS[k] for k in sorted(_RESIDENCY_PROGRAMS))
 
 
 def ensure_populated() -> None:

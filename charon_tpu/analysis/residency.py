@@ -1,0 +1,134 @@
+"""Residency pass (pass 4): trace each registered fused dispatch graph
+end-to-end and fail on any host round-trip between its stage boundaries.
+
+Round 12 fused the verify hot path — signature decompress, device-cache
+row consumption, RLC scaling, the Miller kernel family, the product fold
+and the final exponentiation — into ONE jitted graph precisely to
+eliminate the per-stage fetch/re-upload seams (``np.asarray`` on an
+intermediate, host-computed masks re-uploaded mid-path).  This pass
+makes that property a checked contract instead of a code-review hope:
+
+- Inside a single traced jaxpr a device→host transfer cannot exist as
+  ordinary dataflow.  The only ways device data reaches the host
+  mid-graph are (a) CONCRETISING a tracer — ``np.asarray``, ``bool()``,
+  ``int()``, ``.item()`` on an intermediate — which raises at trace
+  time, and (b) an explicit callback/infeed/outfeed escape-hatch
+  primitive.  The pass asserts both: the registered builder must trace
+  to one jaxpr (a concretisation error IS the reintroduced round-trip,
+  reported against the registered stage chain), and the traced jaxpr
+  must contain none of the transfer primitives.
+- Kernel-level discipline (integer dtypes, scoped-VMEM budgets) is
+  passes 1–2; shard-carry discipline is pass 3.  This pass only checks
+  the SEAMS — so it runs the graph under the DIRECT kernel forms on CPU
+  (the graph structure is identical; tracing the full pallas bodies
+  again here would re-pay minutes of trace time for nothing).
+
+A golden-bad fixture (`fixtures.resident_roundtrip_spec`,
+``--golden-bad resident_roundtrip``) pins detection: a builder that
+fetches an intermediate to the host between two stages must fail here.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from . import registry
+
+#: Primitives that move data off the device mid-graph (the explicit
+#: escape hatches; implicit fetches fail the trace itself).  Subset of
+#: jaxpr_audit.FORBIDDEN_KERNEL_PRIMS — repeated here because this pass
+#: walks WHOLE dispatch graphs, where transcendental float math is
+#: legal (there is none today, but the residency contract is about
+#: transfers, not dtypes).
+TRANSFER_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+})
+
+
+@dataclass
+class ResidencyAudit:
+    """Result of tracing one (kind, v) residency case."""
+
+    name: str
+    kind: str
+    v: int
+    stages: tuple = ()
+    eqns: int | None = None
+    trace_seconds: float | None = None
+    violations: list = field(default_factory=list)
+
+
+def audit_residency_case(spec: registry.ResidencyProgramSpec, kind: str,
+                         v: int) -> ResidencyAudit:
+    """Trace one graph bucket and check the residency contract."""
+    import jax
+
+    audit = ResidencyAudit(name=f"{spec.name}[{kind}, v={v}]", kind=kind,
+                           v=v, stages=tuple(spec.stages))
+    t0 = time.perf_counter()
+    try:
+        closed = jax.make_jaxpr(spec.build(kind, v))(
+            *spec.make_args(kind, v))
+    except Exception as exc:  # noqa: BLE001 — the failure IS the finding
+        audit.violations.append(
+            f"{audit.name}: graph does not trace end-to-end — a host "
+            f"round-trip (or trace error) between the registered stage "
+            f"boundaries {audit.stages}: {type(exc).__name__}: {exc}")
+        return audit
+    audit.trace_seconds = round(time.perf_counter() - t0, 3)
+    n_eqns = 0
+    bad: dict[str, int] = {}
+    # walk each DISTINCT sub-jaxpr once: the Miller loop re-invokes the
+    # same jitted kernel bodies dozens of times, and re-walking a shared
+    # body per call site turns a ~100k-eqn walk into millions for no
+    # additional coverage
+    from .jaxpr_audit import sub_jaxprs
+
+    seen: set[int] = set()
+    stack = [closed.jaxpr]
+    while stack:
+        jx = stack.pop()
+        if id(jx) in seen:
+            continue
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            n_eqns += 1
+            if eqn.primitive.name in TRANSFER_PRIMS:
+                bad[eqn.primitive.name] = bad.get(eqn.primitive.name, 0) + 1
+            stack.extend(sub_jaxprs(eqn))
+    audit.eqns = n_eqns
+    for name, count in sorted(bad.items()):
+        audit.violations.append(
+            f"{audit.name}: device→host transfer primitive '{name}' "
+            f"appears {count}x inside the fused graph — the resident "
+            f"path must stay on device between "
+            f"{audit.stages[0]} and {audit.stages[-1]}")
+    return audit
+
+
+def run_residency_audit(cases=None, direct=None) -> list:
+    """Pass 4 over every registered residency program.
+
+    Traces under the DIRECT kernel forms on CPU unless the default
+    backend is a real TPU (`direct` overrides), mirroring the shard
+    pass: the seams being audited are mode-invariant and the kernel
+    bodies are already covered by passes 1–2."""
+    import jax
+
+    from ..ops import pallas_g2
+
+    registry.ensure_populated()
+    use_direct = (direct if direct is not None
+                  else jax.default_backend() != "tpu")
+    prev = pallas_g2.DIRECT
+    pallas_g2.DIRECT = use_direct
+    out = []
+    try:
+        for spec in registry.residency_programs():
+            for case in (cases if cases is not None else spec.cases):
+                out.append(audit_residency_case(spec, *case))
+    finally:
+        pallas_g2.DIRECT = prev
+    return out

@@ -168,6 +168,40 @@ def set_readiness(registry: "Registry", reason: str) -> None:
     if reason not in READINESS_REASONS:
         registry.set_gauge("app_readiness", 1.0, labels={"reason": reason})
 
+def export_devcache_metrics(registry: "Registry") -> None:
+    """Export the device-resident verify-cache gauges
+    (``charon_tpu_devcache_*``) from the TPU backend's cache stats —
+    refreshed at every /metrics scrape (like readiness) so both the
+    production App and the crypto-free simnet Node serve them without
+    extra wiring.  No-op until the backend module is loaded."""
+    be = sys.modules.get("charon_tpu.tbls.backend_tpu")
+    if be is None:
+        return
+    stats = be.TPUBackend.devcache_stats()
+    registry.set_gauge("charon_tpu_devcache_resident",
+                       1.0 if stats.get("enabled") else 0.0)
+    host = be.TPUBackend.host_cache_stats()
+    for cache in ("pk", "hm"):
+        # one uniform schema whichever residency serves: the device
+        # store when it exists, else the host LRU twin
+        s = stats.get(cache) or host.get(cache)
+        if not s:
+            continue
+        labels = {"cache": cache}
+        registry.set_gauge("charon_tpu_devcache_rows", s["rows"],
+                           labels=labels)
+        registry.set_gauge("charon_tpu_devcache_capacity_rows",
+                           s["capacity_rows"], labels=labels)
+        registry.set_gauge("charon_tpu_devcache_bytes",
+                           s.get("bytes", 0), labels=labels)
+        registry.set_gauge("charon_tpu_devcache_hits_total", s["hits"],
+                           labels=labels)
+        registry.set_gauge("charon_tpu_devcache_misses_total",
+                           s["misses"], labels=labels)
+        registry.set_gauge("charon_tpu_devcache_evictions_total",
+                           s["evictions"], labels=labels)
+
+
 #: Loop-lag probe buckets: the 12 s slot budget makes 1 ms–1 s the band
 #: that matters; the alerting threshold (p99 < 50 ms, the dispatch
 #: pipeline's acceptance bar) needs resolution around 10–100 ms.
@@ -266,6 +300,10 @@ class MonitoringAPI:
                 self._readyz()
             except Exception:  # noqa: BLE001 — scrape must not 500
                 pass
+            try:
+                export_devcache_metrics(self.registry)
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                pass
             return ("200 OK", METRICS_CONTENT_TYPE,
                     self.registry.render().encode())
         if path == "/livez":
@@ -332,10 +370,18 @@ class MonitoringAPI:
             info["pubkey_cache_entries"] = len(be.TPUBackend._PK_CACHE)
             info["pubkey_cache_hits"] = be.TPUBackend.pk_cache_hits
             info["pubkey_cache_misses"] = be.TPUBackend.pk_cache_misses
+            info["pubkey_cache_evictions"] = be.TPUBackend.pk_cache_evictions
             info["hashed_msg_cache_entries"] = len(be.TPUBackend._HM_CACHE)
             info["hashed_msg_cache_hits"] = be.TPUBackend.hm_cache_hits
             info["hashed_msg_cache_misses"] = be.TPUBackend.hm_cache_misses
+            info["hashed_msg_cache_evictions"] = \
+                be.TPUBackend.hm_cache_evictions
             info["h2c_path"] = be.h2c_path()
+            # device-resident cache occupancy (rows/bytes/capacity/
+            # evictions) + the fused-graph compile-cache keys — the
+            # round-12 residency story, answerable from /debug/memory
+            info["devcache"] = be.TPUBackend.devcache_stats()
+            info["resident_graph_keys"] = be.resident_graph_keys()
         if self._tracer is not None:
             info["tracer"] = {"spans_buffered": len(self._tracer.spans),
                               "dropped_spans": self._tracer.dropped}

@@ -377,6 +377,13 @@ class App:
         self.registry.set_gauge("core_verify_launches_total", v.launches)
         self.registry.set_gauge("core_verify_entries_total", v.entries_total)
         self.registry.set_gauge("core_verify_max_batch", v.max_batch)
+        # cross-duty/slot packing efficacy: drains that shared a launch
+        # slot because another launch was in flight (rows-per-launch is
+        # entries_total / launches over a scrape window)
+        self.registry.set_gauge("core_verify_packed_flushes_total",
+                                v.packed_flushes)
+        self.registry.set_gauge("core_verify_packed_entries_total",
+                                v.packed_entries)
         for path, count in v.paths.items():
             # which pairing implementation served the launches: a silent
             # fused→jnp fallback (tbls/backend_tpu) shows up here

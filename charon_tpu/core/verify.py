@@ -30,6 +30,19 @@ per signature and ONE final exponentiation for the whole coalesced batch
 launch-amortised.  `paths` counts launches per pairing implementation
 (`pallas-rlc` / `jnp` / `cpu` / `insecure-test`) so a silent fallback is
 visible at /metrics.
+
+Cross-duty/slot packing (round 12): flushes are drained by a SINGLE
+drainer loop per verifier instead of one launch per flusher task.  While
+a launch is in flight, verify() calls from OTHER duties and slots keep
+queueing; when the launch returns, the drainer packs the whole
+accumulated queue into the next shared RLC batch (the dispatch pipeline
+tiles it at the audited bucket).  Under load this turns "one padded
+batch per duty flush" into "one batch per launch slot, shared across
+every concurrent duty" — more rows per launch and per final
+exponentiation — while per-duty verdict demux stays positional and the
+per-launch span still attributes batch size, paths and coalesced calls.
+`packed_flushes`/`packed_entries` count the drains that landed in a
+shared batch because a launch was already in flight.
 """
 
 from __future__ import annotations
@@ -67,6 +80,12 @@ class BatchVerifier:
         self.entries_total = 0
         self.max_batch = 0
         self.paths: dict = {}  # pairing path -> launch count
+        # cross-duty packing: drains (and their entries) that shared a
+        # launch slot because another launch was in flight when they
+        # were queued — rows-per-launch efficacy for bench/metrics
+        self.packed_flushes = 0
+        self.packed_entries = 0
+        self._draining = False
 
     async def verify(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         """Queue one (pubkey, msg, sig); resolves when the batched launch
@@ -86,10 +105,13 @@ class BatchVerifier:
         loop = asyncio.get_running_loop()
         item = _Pending(entries=list(entries), done=loop.create_future())
         self._queue.append(item)
-        # Every call spawns a flusher; after the coalescing sleep the first
-        # one to wake drains the whole queue and the rest no-op (same
-        # rationale as sigagg: a shared "flusher running" flag would race
-        # with entries enqueued mid-launch).
+        # Every call spawns a flusher; after the coalescing sleep the
+        # first one to wake becomes THE drainer and loops until the
+        # queue is empty (entries enqueued mid-launch are picked up by
+        # its next iteration as a shared packed batch); later flushers
+        # see `_draining` and no-op.  The drainer clears the flag with
+        # no await after its final empty-queue check, so nothing can be
+        # stranded between drainer exit and the next flusher task.
         loop.create_task(self._flush())
         return await item.done
 
@@ -98,9 +120,35 @@ class BatchVerifier:
             await asyncio.sleep(self._flush_interval)
         else:
             await asyncio.sleep(0)
-        batch, self._queue = self._queue, []
-        if not batch:
-            return  # a sibling flusher already drained the queue
+        if self._draining:
+            # a drainer is live: after its current launch returns it
+            # re-checks the queue and packs these entries into the next
+            # SHARED batch (cross-duty/slot packing) — spawning a second
+            # concurrent launch here would fragment the RLC batches
+            return
+        self._draining = True
+        try:
+            first = True
+            while self._queue:
+                batch, self._queue = self._queue, []
+                if not first:
+                    # everything in this drain queued while the previous
+                    # launch was in flight: it shares one launch slot
+                    self.packed_flushes += 1
+                    self.packed_entries += sum(
+                        len(item.entries) for item in batch)
+                first = False
+                await self._launch(batch)
+        finally:
+            # no await between the final while-condition check and this
+            # clear (both run in one event-loop step), so an entry can
+            # never be stranded between drainer exit and the next
+            # flusher task
+            self._draining = False
+
+    async def _launch(self, batch: list[_Pending]) -> None:
+        """One coalesced launch unit: resolve the pipeline, span it,
+        demux per-duty verdicts positionally, fire the hook."""
         flat = [e for item in batch for e in item.entries]
         pipe = self._dispatcher
         if pipe is None:

@@ -186,6 +186,58 @@ def h2c_step_footprint_bytes(n_in_planes: int, n_out_planes: int,
             + h2c_const_block_bytes())
 
 
+# ---------------------------------------------------------------------------
+# Device-resident cache (HBM) residency model (tbls/devcache).
+#
+# The device-resident pubkey / hashed-message caches keep decompressed
+# rows in the tiled limbs-major [planes, NLIMBS, S, LANES] layout in HBM
+# (NOT scoped VMEM — the kernels stream tiles out of it like any other
+# operand), so the budget here is an HBM residency allowance, not the
+# 16 MiB scoped-VMEM hard limit above.  The model is deliberately the
+# same shape as the VMEM one: a single source of truth for "how many
+# rows fit", asserted by tests, so capacity can never silently drift
+# from what /debug/memory and the metrics report.
+# ---------------------------------------------------------------------------
+
+#: Default HBM allowance for the device-resident row caches, split
+#: between the pubkey and hashed-message stores by their `share`.
+DEVCACHE_DEFAULT_MB = 96.0
+_DEVCACHE_ENV = "CHARON_TPU_DEVCACHE_MB"
+
+
+def devcache_budget_bytes() -> int:
+    """The configured device-cache HBM allowance
+    (``CHARON_TPU_DEVCACHE_MB``, default 96 MiB).  Unlike the scoped-VMEM
+    budget there is no 16 MiB ceiling — HBM is GBs — but non-positive
+    values are rejected: a zero-capacity cache would evict every row at
+    insert and silently degrade every flush to the miss path."""
+    mb = float(os.environ.get(_DEVCACHE_ENV, DEVCACHE_DEFAULT_MB))
+    if mb <= 0:
+        raise ValueError(
+            f"{_DEVCACHE_ENV}={mb} must be positive; use "
+            f"CHARON_TPU_DEVCACHE=0 to disable the resident path instead")
+    return int(mb * 1024 * 1024)
+
+
+def devcache_row_bytes(n_planes: int) -> int:
+    """HBM bytes one cached row holds: `n_planes` Fp limb planes of
+    NLIMBS int32 lanes (a G1 pubkey is 3 planes, an affine G2 hashed
+    message 6)."""
+    return n_planes * NLIMBS * INT32
+
+
+def devcache_capacity_rows(n_planes: int, share: float = 1.0,
+                           budget: int | None = None) -> int:
+    """Row capacity of one device cache under its HBM share, rounded
+    DOWN to the LANES tile granularity (the store's S axis is whole
+    128-lane columns) with a one-tile floor so a tiny budget still
+    yields a functioning cache."""
+    if budget is None:
+        budget = devcache_budget_bytes()
+    rows = int(budget * share) // devcache_row_bytes(n_planes)
+    return max(LANES, (rows // LANES) * LANES)
+
+
 def _search_tile(footprint_fn, s_rows: int, budget: int | None,
                  what: str) -> int:
     """The shared tile search: the largest S tile (rows, multiple of

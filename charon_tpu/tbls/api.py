@@ -417,6 +417,19 @@ def combine_path() -> str:
     return path_fn() if path_fn is not None else _current.name
 
 
+def devcache_path() -> str:
+    """Which cache residency serves verifies on the active scheme/
+    backend: ``resident`` (device-resident pubkey/hashed-message caches
+    + the fused end-to-end graph, `tbls.devcache`) or ``bytes`` (the
+    host-cache byte paths); ``n/a`` for backends without device caches.
+    Bench + debug attribution, symmetric with :func:`verify_path` /
+    :func:`combine_path`."""
+    if _scheme == "insecure-test":
+        return "insecure-test"
+    fn = getattr(_current, "devcache_path", None)
+    return fn() if fn is not None else "n/a"
+
+
 def verify_padded_rows(n: int) -> int:
     """Device rows an n-entry `batch_verify` actually launches after the
     backend's padding (power-of-two / tile-grid floors).  Backends

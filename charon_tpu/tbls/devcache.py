@@ -1,0 +1,259 @@
+"""Device-resident row caches for the verify hot path.
+
+Until round 12 the decompressed-pubkey and hashed-message caches lived on
+the HOST (`backend_tpu._PK_CACHE`/`_HM_CACHE`): a cache hit still shipped
+its bytes device-ward on every flush, so at V=10k the verify path paid a
+full host→device upload of material that is static per cluster (pubshares)
+or hot across slots (attestation roots).  This module keeps those rows ON
+DEVICE instead, in the same tiled limbs-major ``[planes, NLIMBS, S, 128]``
+layout the fused kernels consume — a cache-hit row contributes ZERO
+host→device bytes to a flush; the prep stage shrinks to gathering slot
+indices and packing only the miss rows.
+
+Design:
+
+- The store is one fixed-capacity device array (HBM, sized by
+  `ops/vmem_budget.devcache_capacity_rows`); row *r* lives at tiled
+  position ``(s = r // 128, lane = r % 128)``.
+- Keying/LRU/occupancy bookkeeping is host-side (an OrderedDict of
+  key → slot), under one lock.  EVERY operation that dispatches device
+  work against the store (the scatter of committed rows, the gather of a
+  batch's rows) also runs under that lock, so the Python-visible store
+  reference and the dispatch order can never interleave badly across the
+  prep / launch / prewarm threads; the device work itself is async and
+  the PJRT runtime sequences a donated store update after all pending
+  reads of the donated buffer.
+- `commit` updates the store through a DONATED jit
+  (``donate_argnums=(0,)``): the old store buffer is reused in place —
+  the cache never holds two store-sized buffers alive.
+- Batches take their rows through `lookup_rows`, which gathers the hit
+  rows UNDER THE SAME LOCK as the lookup: the [n, planes, NLIMBS] rows
+  are materialised as a fresh device array before any concurrent
+  commit (another prep, the prewarm thread, a fallback re-prep on the
+  launch thread) could evict one of the hit slots — there is no
+  lookup→gather window at all, and no slot pinning across the dispatch
+  pipeline's double buffer.  Miss positions hold placeholder rows; the
+  caller patches them from its freshly computed rows and `commit`s
+  those purely for FUTURE batches (the current batch never depends on
+  the slots that commit assigns, so eviction pressure cannot corrupt
+  it either).
+- When a commit larger than the whole cache would have to evict rows
+  inserted by the SAME commit, the excess keys are returned as −1
+  (overflow: counted, not cached, never fatal) instead of thrashing.
+
+The cache is scheme-agnostic (it stores int32 limb planes by opaque byte
+keys) and import-cheap apart from jax itself.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+from ..ops import vmem_budget
+
+LANES = vmem_budget.LANES
+NLIMBS = vmem_budget.NLIMBS
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(store, rows, slots):
+    """Write `rows` [m, planes, NLIMBS] into tiled `store` at `slots`
+    [m] — the store buffer is DONATED so the update is in place."""
+    planes, nlimbs = store.shape[0], store.shape[1]
+    flat = store.reshape(planes, nlimbs, -1)
+    flat = flat.at[:, :, slots].set(rows.transpose(1, 2, 0))
+    return flat.reshape(store.shape)
+
+
+@jax.jit
+def _gather_rows(store, idx):
+    """Read rows [n, planes, NLIMBS] out of tiled `store` at `idx` [n]."""
+    planes, nlimbs = store.shape[0], store.shape[1]
+    flat = store.reshape(planes, nlimbs, -1)
+    return flat[:, :, idx].transpose(2, 0, 1)
+
+
+def _pad_pow2(n: int, floor: int = 1) -> int:
+    m = max(n, floor)
+    return 1 << (m - 1).bit_length()
+
+
+class DeviceRowCache:
+    """Fixed-capacity device-resident LRU row cache (module docstring)."""
+
+    def __init__(self, name: str, n_planes: int, capacity_rows: int):
+        if capacity_rows < LANES or capacity_rows % LANES:
+            raise ValueError(
+                f"devcache {name!r}: capacity {capacity_rows} rows must be "
+                f"a positive multiple of {LANES} (whole tiled columns)")
+        self.name = name
+        self.n_planes = n_planes
+        self.capacity_rows = capacity_rows
+        self._store = None                       # lazy [P, NLIMBS, S, 128]
+        self._slots: OrderedDict[bytes, int] = OrderedDict()
+        self._free = list(range(capacity_rows - 1, -1, -1))
+        self._ok = np.ones(capacity_rows, bool)
+        self._lock = threading.Lock()
+        # cumulative efficacy counters (exported at /debug/memory and as
+        # charon_tpu_devcache_* metrics; uniform with the host caches)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.inserts = 0
+        self.overflows = 0
+
+    # -- store plumbing ------------------------------------------------------
+
+    def _ensure_store(self):
+        if self._store is None:
+            import jax.numpy as jnp
+
+            self._store = jnp.zeros(
+                (self.n_planes, NLIMBS, self.capacity_rows // LANES, LANES),
+                jnp.int32)
+        return self._store
+
+    def row_bytes(self) -> int:
+        return vmem_budget.devcache_row_bytes(self.n_planes)
+
+    # -- public --------------------------------------------------------------
+
+    def _lookup_locked(self, keys) -> tuple[np.ndarray, np.ndarray, list]:
+        idx = np.empty(len(keys), np.int32)
+        ok = np.ones(len(keys), bool)
+        missing: dict[bytes, None] = {}
+        for k, key in enumerate(keys):
+            slot = self._slots.get(key)
+            if slot is None:
+                idx[k] = -1
+                missing[key] = None
+            else:
+                self._slots.move_to_end(key)
+                idx[k] = slot
+                ok[k] = self._ok[slot]
+        n_miss = int((idx < 0).sum())
+        self.hits += len(keys) - n_miss
+        self.misses += n_miss
+        return idx, ok, list(missing)
+
+    def lookup(self, keys) -> tuple[np.ndarray, np.ndarray, list]:
+        """→ (slot idx int32 [n] with −1 for misses, ok bool [n],
+        deduplicated miss keys in first-seen order).  Hits are touched
+        to most-recently-used.  Bookkeeping only — batches that need
+        the ROWS must use `lookup_rows`, which closes the lookup→gather
+        race against concurrent commits."""
+        with self._lock:
+            return self._lookup_locked(keys)
+
+    def lookup_rows(self, keys):
+        """→ (idx, ok, missing, rows [n, planes, NLIMBS] device array):
+        lookup + hit-row gather under ONE lock acquisition, so a
+        concurrent commit from another thread (prewarm, fallback
+        re-prep, the other prep batch) can never evict a hit slot
+        between this batch's lookup and its gather — the rows are
+        already materialised when the lock drops.  Miss positions hold
+        the slot-0 placeholder row; the caller overwrites them from its
+        computed miss rows."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            idx, ok, missing = self._lookup_locked(keys)
+            rows = _gather_rows(self._ensure_store(),
+                                jnp.asarray(np.maximum(idx, 0)))
+        return idx, ok, missing, rows
+
+    def commit(self, keys, rows, ok, protect=None) -> np.ndarray:
+        """Insert computed `rows` ([m, planes, NLIMBS], device or host)
+        for `keys`, evicting LRU residents as needed — purely for
+        FUTURE batches: callers take the current batch's rows from
+        `lookup_rows` + their own computed miss rows, never from the
+        slots assigned here.  Slots allocated within this commit are
+        never chosen as eviction victims (plus any caller-supplied
+        `protect` slots); when nothing else is evictable the key is
+        returned as −1 (overflow: counted, not cached)."""
+        import jax.numpy as jnp
+
+        if not len(keys):
+            return np.empty(0, np.int32)
+        protected = {int(s) for s in (protect if protect is not None else ())
+                     if int(s) >= 0}
+        slots = np.empty(len(keys), np.int32)
+        with self._lock:
+            for j, key in enumerate(keys):
+                slot = self._slots.get(key)
+                if slot is not None:            # raced in by another thread
+                    self._slots.move_to_end(key)
+                elif self._free:
+                    slot = self._free.pop()
+                    self._slots[key] = slot
+                    self.inserts += 1
+                else:
+                    slot = None
+                    for old_key, old_slot in self._slots.items():
+                        if old_slot not in protected:
+                            slot = old_slot
+                            break
+                    if slot is None:            # everything belongs to the
+                        slots[j] = -1           # in-flight batch: overflow
+                        self.overflows += 1
+                        continue
+                    del self._slots[old_key]
+                    self._slots[key] = slot
+                    self.evictions += 1
+                    self.inserts += 1
+                protected.add(slot)
+                self._ok[slot] = bool(ok[j])
+                slots[j] = slot
+            cached = np.flatnonzero(slots >= 0)
+            if len(cached):
+                # pad to a pow2 bucket so the donated scatter compiles
+                # O(log n) shapes; duplicate trailing (slot, row) pairs
+                # write identical data, so the duplicate-index update is
+                # value-deterministic
+                mp = _pad_pow2(len(cached))
+                sel = np.concatenate(
+                    [cached, np.full(mp - len(cached), cached[-1])])
+                rows = jnp.asarray(rows)
+                self._store = _scatter_rows(
+                    self._ensure_store(), rows[sel],
+                    jnp.asarray(slots[sel]))
+        return slots
+
+    def gather(self, idx: np.ndarray):
+        """Materialise rows [n, planes, NLIMBS] for slot `idx` (no −1
+        entries — overflow positions must be patched by the caller) as a
+        fresh device array."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            return _gather_rows(self._ensure_store(),
+                                jnp.asarray(np.maximum(idx, 0)))
+
+    def clear(self) -> None:
+        """Drop every resident row (tests / bench cold-cache reps);
+        counters stay cumulative, the store buffer is released."""
+        with self._lock:
+            self._slots.clear()
+            self._free = list(range(self.capacity_rows - 1, -1, -1))
+            self._ok[:] = True
+            self._store = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            rows = len(self._slots)
+        return {
+            "rows": rows,
+            "capacity_rows": self.capacity_rows,
+            "bytes": rows * self.row_bytes(),
+            "capacity_bytes": self.capacity_rows * self.row_bytes(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "inserts": self.inserts,
+            "overflows": self.overflows,
+        }

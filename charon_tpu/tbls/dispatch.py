@@ -49,6 +49,13 @@ Env knobs (all read per call, so tests can flip them):
                                  suites so a regression to inline
                                  launches fails CI).
 
+Related (owned by `tbls.backend_tpu`, listed here because they shape
+what the pipeline stages do): ``CHARON_TPU_DEVCACHE`` (auto/1/0 —
+device-resident pubkey/hashed-message caches + the fused end-to-end
+verify graph; prep shrinks to cache-slot gathering + miss packing) and
+``CHARON_TPU_DEVCACHE_MB`` (the HBM residency allowance,
+`ops.vmem_budget.devcache_capacity_rows`).
+
 This module is stdlib-only (no jax import) so the guard and knobs are
 usable from any layer without dragging the device stack in.
 """
@@ -165,6 +172,10 @@ class DispatchPipeline:
         self.prep_busy_s = 0.0
         self.device_busy_s = 0.0
         self.launches = 0
+        #: cumulative verify entries submitted — rows-per-launch
+        #: (verify_rows / launches over a window) is the cross-duty
+        #: packing efficacy the round-12 bench reports
+        self.verify_rows = 0
         self.prewarmed: dict | None = None
 
     # -- stage plumbing ------------------------------------------------------
@@ -235,6 +246,7 @@ class DispatchPipeline:
         n = len(entries)
         if n == 0:
             return []
+        self.verify_rows += n
         # tile_sizes never returns an empty plan (tile ≤ 0 → one
         # whole-batch launch): an empty plan would resolve every awaiter
         # with zero verdicts and fail OPEN at `all([])` call-sites

@@ -271,6 +271,35 @@ def test_golden_bad_replicated_carry_flagged():
     assert "carry" in text and "replicated" in text
 
 
+def test_golden_bad_resident_roundtrip_flagged():
+    """Pass 4 (residency): a fused-graph builder that fetches an
+    intermediate to the host between two stage boundaries must fail the
+    trace — the reintroduced fetch/re-upload seam the round-12 resident
+    verify graph exists to eliminate."""
+    report = audit_golden_bad("resident_roundtrip")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "round-trip" in text and "stage boundaries" in text
+    [case] = report.residency_cases
+    assert case.stages == ("scale", "offset")
+
+
+def test_residency_pass_in_report_surfaces():
+    """Residency cases ride the shared AuditReport plumbing: summary
+    lines, to_dict, violation aggregation (the real fused buckets are
+    traced by the slow-lane full audit / CLI)."""
+    from charon_tpu.analysis.fixtures import resident_roundtrip_spec
+    from charon_tpu.analysis.residency import audit_residency_case
+    from charon_tpu.analysis.audit import AuditReport
+
+    report = AuditReport()
+    spec = resident_roundtrip_spec()
+    report.residency_cases.append(audit_residency_case(spec, "jnp", 8))
+    assert not report.ok
+    assert "resident end-to-end" in report.summary()
+    assert report.to_dict()["residency_cases"][0]["violations"]
+
+
 def test_golden_bad_float_leak_flagged():
     report = audit_golden_bad("float_leak")
     assert not report.ok
