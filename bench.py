@@ -341,15 +341,39 @@ def main() -> None:
             if c.get("hot_sigs_per_s"):
                 result["verify_trajectory"]["r12_hot_vs_r04"] = round(
                     c["hot_sigs_per_s"] / 1976, 2)
+    # live pipeline stage decomposition + overlap from the process
+    # pipeline the prewarm/dispatch sections exercised (the production
+    # /metrics twin of the per-config overlap_efficiency numbers)
+    pipe = tdispatch.current_pipeline()
+    if pipe is not None:
+        result["dispatch"]["stage_stats"] = pipe.stage_stats()
+    from charon_tpu.tbls import backend_tpu as _be
+
+    result["compile_programs"] = _be.compile_stats()
+
     out = json.dumps(result)
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r12.json")
+        path = os.path.join(repo_dir, "BENCH_r13.json")
         with open(path, "w") as fh:
             fh.write(out + "\n")
     except OSError:
         pass
     print(out)
+
+    # ---- postflight: bench-trend regression gate --------------------------
+    # Parse the whole BENCH_r*.json history (including the file just
+    # written) into BENCH_TREND.json and fail the bench if a tracked
+    # metric regressed more than the tolerance vs its best round —
+    # symmetric with the kernel-contract preflight.  Table/diagnostics
+    # go to stderr so stdout stays exactly one JSON line.
+    if os.environ.get("CHARON_TPU_BENCH_TREND", "1") != "0":
+        from charon_tpu.analysis import bench_trend
+
+        rc = bench_trend.main(["--dir", repo_dir, "--check-regression"],
+                              out=sys.stderr)
+        if rc:
+            sys.exit(rc)
 
 
 def _run_baseline_configs(api, rng, pool_bytes,

@@ -27,7 +27,7 @@ def main(argv=None) -> int:
     ap.add_argument("--golden-bad",
                     choices=["r05_vmem", "replicated_carry", "float_leak",
                              "bad_buckets", "unbounded_label",
-                             "resident_roundtrip"],
+                             "undocumented_metric", "resident_roundtrip"],
                     help="audit a known-broken fixture instead of HEAD "
                          "(expected exit status: non-zero)")
     ap.add_argument("--trace", default="all",

@@ -1,0 +1,339 @@
+"""Bench-trajectory trend + regression gate over the BENCH_r*.json
+history.
+
+Every bench round leaves a ``BENCH_r<NN>.json`` in the repo root — either
+the driver's wrapper form (``{"n": NN, "rc": 0, "parsed": {...}}``) or
+bench.py's own raw result line — but until now the trajectory was
+eyeballed: nothing machine-checked that verify throughput, combine
+latency, overlap efficiency or first-duty latency held their ground from
+round to round.  This module turns the files into a machine-readable
+trend (``BENCH_TREND.json`` + a printed table) and a GATE:
+
+    python -m charon_tpu.analysis.bench_trend --check-regression
+
+exits non-zero when any tracked metric in the LATEST successful round
+regresses more than ``--tolerance`` (default 10%) against its best
+recorded round.  bench.py runs the gate as a postflight after writing
+its own JSON, so a perf regression fails the bench run the way a kernel
+contract violation fails the preflight.
+
+Pure stdlib JSON parsing — no jax, runs in tier-1 on synthetic fixtures
+and on the real repo history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+@dataclass(frozen=True)
+class TrendMetric:
+    """One tracked series: how to pull it out of a round's parsed bench
+    result, and which direction is better."""
+
+    name: str
+    higher_is_better: bool
+    unit: str
+    extract: "callable"
+
+
+def _dispatch_field(key: str):
+    def get(parsed: dict):
+        return (parsed.get("dispatch") or {}).get(key)
+
+    return get
+
+
+def _max_config_overlap(parsed: dict):
+    """Best overlap efficiency across the pipeline A/B configs (the
+    bench reports one per config; the trend tracks the best the
+    pipeline demonstrated that round)."""
+    best = None
+    for c in parsed.get("configs") or []:
+        v = c.get("overlap_efficiency")
+        if v is not None and (best is None or v > best):
+            best = v
+    return best
+
+
+#: The gated series.  Keys must stay stable: BENCH_TREND.json consumers
+#: and the regression gate key on them.
+TRACKED: tuple[TrendMetric, ...] = (
+    TrendMetric("verify_sigs_per_s", True, "sigs/s",
+                lambda p: p.get("verify_throughput_sig_s")),
+    TrendMetric("combine_p50_ms", False, "ms",
+                lambda p: p.get("p50_ms")),
+    TrendMetric("sigagg_p99_ms", False, "ms",
+                lambda p: (p.get("value")
+                           if p.get("metric") == "sigagg_latency_p99_ms"
+                           else None)),
+    TrendMetric("overlap_efficiency", True, "ratio",
+                _max_config_overlap),
+    TrendMetric("first_duty_verify_ms", False, "ms",
+                _dispatch_field("first_duty_verify_ms")),
+    TrendMetric("first_duty_combine_ms", False, "ms",
+                _dispatch_field("first_duty_combine_ms")),
+)
+
+
+@dataclass
+class Round:
+    n: int
+    path: str
+    ok: bool
+    values: dict = field(default_factory=dict)
+    note: str = ""
+    #: the jax platform the round measured on (None when the round
+    #: predates the field) — the gate only compares LIKE platforms, so
+    #: a CPU dry run can never "regress" against a TPU best
+    platform: str | None = None
+
+
+def parse_round_file(path: str) -> Round:
+    """One BENCH_r*.json → Round.  Accepts both the driver wrapper
+    ({"n", "rc", "parsed"}) and bench.py's raw result dict; a failed
+    round (non-zero rc / unparseable) stays in the trajectory as a gap,
+    never as a zero."""
+    m = _ROUND_RE.search(os.path.basename(path))
+    n = int(m.group(1)) if m else -1
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return Round(n=n, path=path, ok=False, note=f"unreadable: {exc}")
+    if not isinstance(doc, dict):
+        return Round(n=n, path=path, ok=False, note="not a JSON object")
+    if "parsed" in doc or "rc" in doc:            # driver wrapper form
+        n = int(doc.get("n", n))
+        parsed = doc.get("parsed")
+        if doc.get("rc", 1) != 0 or not isinstance(parsed, dict):
+            return Round(n=n, path=path, ok=False,
+                         note=f"bench failed (rc={doc.get('rc')})")
+    else:                                          # bench.py raw form
+        parsed = doc
+    platform = parsed.get("platform")
+    values = {}
+    for metric in TRACKED:
+        try:
+            v = metric.extract(parsed)
+        except Exception:  # noqa: BLE001 — one malformed field ≠ no round
+            v = None
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            values[metric.name] = float(v)
+    return Round(n=n, path=path, ok=True, values=values,
+                 platform=platform if isinstance(platform, str) else None)
+
+
+def load_rounds(bench_dir: str) -> list[Round]:
+    """All BENCH_r*.json under `bench_dir`, round-ordered."""
+    rounds = [parse_round_file(p)
+              for p in glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))]
+    return sorted(rounds, key=lambda r: r.n)
+
+
+def build_trend(rounds: list[Round]) -> dict:
+    """The trajectory document written to BENCH_TREND.json: per-metric
+    series over successful rounds, each metric's best round, and the
+    latest successful round's snapshot."""
+    ok_rounds = [r for r in rounds if r.ok]
+    series: dict[str, list] = {m.name: [] for m in TRACKED}
+    best: dict[str, dict] = {}
+    for r in ok_rounds:
+        for m in TRACKED:
+            v = r.values.get(m.name)
+            if v is None:
+                continue
+            series[m.name].append({"round": r.n, "value": v,
+                                   "platform": r.platform})
+            cur = best.get(m.name)
+            improved = (cur is None
+                        or (v > cur["value"] if m.higher_is_better
+                            else v < cur["value"]))
+            if improved:
+                best[m.name] = {"round": r.n, "value": v,
+                                "platform": r.platform}
+    latest = ok_rounds[-1] if ok_rounds else None
+    return {
+        "rounds": [{"round": r.n, "ok": r.ok,
+                    **({"note": r.note} if r.note else {}),
+                    **({"platform": r.platform} if r.platform else {}),
+                    **({"values": r.values} if r.ok else {})}
+                   for r in rounds],
+        "metrics": {m.name: {"unit": m.unit,
+                             "higher_is_better": m.higher_is_better}
+                    for m in TRACKED},
+        "series": {k: v for k, v in series.items() if v},
+        "best": best,
+        "latest": ({"round": latest.n, "values": latest.values,
+                    "platform": latest.platform}
+                   if latest is not None else None),
+    }
+
+
+def _best_for_platform(trend: dict, metric: TrendMetric,
+                       platform: str | None) -> dict | None:
+    """Best recorded point of `metric` on a COMPARABLE platform: a
+    round's number is only meaningful against the same hardware (a CPU
+    dry run must never 'regress' against a TPU best, and vice versa).
+    Points without a recorded platform (pre-field rounds) match
+    anything — conservative: old rounds keep gating."""
+    best = None
+    for pt in trend["series"].get(metric.name, ()):
+        if (platform is not None and pt.get("platform") is not None
+                and pt["platform"] != platform):
+            continue
+        if (best is None
+                or (pt["value"] > best["value"] if metric.higher_is_better
+                    else pt["value"] < best["value"])):
+            best = pt
+    return best
+
+
+def check_regression(trend: dict, tolerance: float = 0.10) -> list[str]:
+    """Gate: the latest successful round vs each metric's best recorded
+    round ON THE SAME PLATFORM.  Returns human-readable failures (empty
+    = pass).  A metric the latest round does not report is a WARNING
+    path handled by the caller (the gate cannot compare what was not
+    measured), never a silent pass of a regressed value."""
+    failures = []
+    latest = trend.get("latest")
+    if latest is None:
+        return ["no successful bench round found — nothing to gate"]
+    platform = latest.get("platform")
+    for m in TRACKED:
+        best = _best_for_platform(trend, m, platform)
+        v = latest["values"].get(m.name)
+        if best is None or v is None:
+            continue
+        if m.higher_is_better:
+            floor = best["value"] * (1.0 - tolerance)
+            if v < floor:
+                failures.append(
+                    f"{m.name}: r{latest['round']:02d} = {v:g} {m.unit} "
+                    f"regressed > {tolerance:.0%} below best "
+                    f"r{best['round']:02d} = {best['value']:g} "
+                    f"(platform={platform or 'any'})")
+        else:
+            ceil = best["value"] * (1.0 + tolerance)
+            if v > ceil:
+                failures.append(
+                    f"{m.name}: r{latest['round']:02d} = {v:g} {m.unit} "
+                    f"regressed > {tolerance:.0%} above best "
+                    f"r{best['round']:02d} = {best['value']:g} "
+                    f"(platform={platform or 'any'})")
+    return failures
+
+
+def untracked_in_latest(trend: dict) -> list[str]:
+    """Tracked metrics with history that the latest round did not
+    report — surfaced as warnings so a silently-dropped measurement
+    cannot hide a regression forever."""
+    latest = trend.get("latest")
+    if latest is None:
+        return []
+    return sorted(
+        m.name for m in TRACKED
+        if m.name in trend["best"] and m.name not in latest["values"])
+
+
+def render_table(trend: dict) -> str:
+    """The key series as a round × metric table (fixed width, no deps)."""
+    names = [m.name for m in TRACKED if trend["series"].get(m.name)]
+    if not names:
+        return "(no tracked metrics in any successful round)"
+    by_round: dict[int, dict] = {}
+    for name in names:
+        for pt in trend["series"][name]:
+            by_round.setdefault(pt["round"], {})[name] = pt["value"]
+    width = {name: max(len(name), 12) for name in names}
+    head = "round  " + "  ".join(f"{n:>{width[n]}}" for n in names)
+    lines = [head, "-" * len(head)]
+    for rn in sorted(by_round):
+        row = [f"r{rn:02d}  "]
+        for name in names:
+            v = by_round[rn].get(name)
+            cell = f"{v:g}" if v is not None else "—"
+            row.append(f"{cell:>{width[name]}}")
+        lines.append("  ".join(row))
+    for name, b in sorted(trend["best"].items()):
+        lines.append(f"best {name}: {b['value']:g} (r{b['round']:02d})")
+    return "\n".join(lines)
+
+
+def repo_root() -> str:
+    """The directory the BENCH files live in: the repo root two levels
+    above this package module."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None, out=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m charon_tpu.analysis.bench_trend",
+        description="BENCH_r*.json trajectory + perf regression gate")
+    ap.add_argument("--dir", default=None,
+                    help="directory holding BENCH_r*.json "
+                         "(default: the repo root)")
+    ap.add_argument("--out", default=None,
+                    help="trend JSON output path (default: "
+                         "<dir>/BENCH_TREND.json; '-' disables the write)")
+    ap.add_argument("--check-regression", action="store_true",
+                    help="exit non-zero when the latest round regresses "
+                         "more than --tolerance vs the best round")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional regression vs the best "
+                         "round (default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the trend document instead of the table")
+    args = ap.parse_args(argv)
+    out = out if out is not None else sys.stdout
+
+    bench_dir = args.dir or repo_root()
+    rounds = load_rounds(bench_dir)
+    if not rounds:
+        print(f"no BENCH_r*.json under {bench_dir}", file=out)
+        return 2
+    trend = build_trend(rounds)
+
+    out_path = args.out or os.path.join(bench_dir, "BENCH_TREND.json")
+    if out_path != "-":
+        try:
+            with open(out_path, "w", encoding="utf-8") as fh:
+                json.dump(trend, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+        except OSError as exc:
+            print(f"warning: could not write {out_path}: {exc}", file=out)
+
+    if args.json:
+        print(json.dumps(trend, indent=1, sort_keys=True), file=out)
+    else:
+        print(render_table(trend), file=out)
+
+    rc = 0
+    if args.check_regression:
+        for name in untracked_in_latest(trend):
+            print(f"warning: latest round does not report {name} "
+                  f"(best on record: {trend['best'][name]['value']:g} at "
+                  f"r{trend['best'][name]['round']:02d})", file=out)
+        failures = check_regression(trend, tolerance=args.tolerance)
+        if failures:
+            for f in failures:
+                print(f"REGRESSION: {f}", file=out)
+            rc = 1
+        else:
+            print(f"regression gate: PASS (tolerance "
+                  f"{args.tolerance:.0%}, latest round "
+                  f"r{trend['latest']['round']:02d})", file=out)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,10 +25,12 @@ tier-1 (tests/test_static_analysis.py) and demonstrable from the CLI
   float32 and calls a transcendental — the dtype-discipline pass must
   flag both.
 
-- `bad_buckets` / `unbounded_label`: metrics-lint golden-bads — a
-  non-monotone bucket ladder with an explicit +Inf, and guarded labels
-  (`reason`/`peer`) fed from interpolated runtime strings (the
-  unbounded-cardinality series factory).  Pure AST, no jax needed.
+- `bad_buckets` / `unbounded_label` / `undocumented_metric`:
+  metrics-lint golden-bads — a non-monotone bucket ladder with an
+  explicit +Inf, guarded labels (`reason`/`peer`) fed from interpolated
+  runtime strings (the unbounded-cardinality series factory), and
+  catalogue drift in both directions (exported-but-undocumented +
+  documented-but-never-exported).  Pure AST, no jax needed.
 """
 
 from __future__ import annotations
@@ -179,6 +181,28 @@ reg.observe("app_fixture_seconds", 0.1,
             labels={"path": "{}/{}".format(a, b)})
 '''
 
+#: Catalogue-drift golden-bad: the code exports a family the doc never
+#: mentions AND the doc documents a family no code exports — both
+#: directions of drift must be flagged (an undocumented metric is
+#: un-dashboardable; a stale row is an alert firing on nothing).
+UNDOCUMENTED_METRIC_SRC = '''\
+reg.inc("app_fixture_documented_total")
+reg.set_gauge("app_fixture_undocumented_rows", 3.0)
+reg.observe("app_fixture_latency_seconds", 0.2)
+'''
+
+UNDOCUMENTED_METRIC_DOC = '''\
+# Observability (fixture)
+
+| metric | type | meaning |
+|---|---|---|
+| `app_fixture_documented_total` | counter | a documented counter |
+| `app_fixture_ghost_total` | counter | documented but never exported |
+
+Alert expr: histogram_quantile(0.99,
+  rate(app_fixture_latency_seconds_bucket[5m])) — suffix normalises.
+'''
+
 
 def resident_roundtrip_spec() -> registry.ResidencyProgramSpec:
     """The residency-pass golden-bad: a fused-graph builder that fetches
@@ -212,6 +236,15 @@ def lint_golden_bad(which: str):
     """Run the metrics lint over one known-bad source fixture."""
     from .metrics_lint import lint_sources
 
+    if which == "undocumented_metric":
+        # catalogue-drift fixture: both directions must be flagged
+        # (app_fixture_undocumented_rows / app_fixture_latency_seconds
+        # are exported-but-undocumented, app_fixture_ghost_total is
+        # documented-but-never-exported; the _bucket reference in the
+        # alert expr must NOT count as drift)
+        return lint_sources(
+            {f"charon_tpu/golden_bad_{which}.py": UNDOCUMENTED_METRIC_SRC},
+            catalogue_doc=UNDOCUMENTED_METRIC_DOC)
     src = {"bad_buckets": BAD_BUCKETS_SRC,
            "unbounded_label": UNBOUNDED_LABEL_SRC}[which]
     return lint_sources({f"charon_tpu/golden_bad_{which}.py": src})
@@ -221,7 +254,7 @@ def audit_golden_bad(which: str):
     """Audit one golden-bad fixture; the returned report must NOT be ok."""
     from .audit import AuditReport, audit_kernel
 
-    if which in ("bad_buckets", "unbounded_label"):
+    if which in ("bad_buckets", "unbounded_label", "undocumented_metric"):
         # pure-AST lint fixtures: no kernel registry (and no jax) needed
         report = AuditReport()
         report.metrics_lint = lint_golden_bad(which)

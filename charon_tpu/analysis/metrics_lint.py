@@ -27,6 +27,11 @@ write surface), and fails on:
   ``str()`` of anything but a plain name/attribute — as label values:
   one exception message interpolated into a ``reason`` label is an
   unbounded series factory that OOMs the scraper, not a metric.
+- catalogue drift: every exported metric family must appear in the
+  docs/observability.md metric catalogue, and every metric the doc
+  names must exist in code.  A metric a dashboard can't find in the
+  docs is unusable; a documented metric that quietly stopped being
+  exported is an alert rule firing on nothing.
 
 Runs inside ``python -m charon_tpu.analysis`` (every audit includes it)
 and tier-1 (tests/test_static_analysis.py).  Pure AST — no imports of
@@ -54,12 +59,23 @@ _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 #: indices, pipeline phases).  An interpolated string under one of these
 #: keys mints a new series per distinct value — unbounded cardinality.
 GUARDED_LABEL_KEYS = ("reason", "peer", "step", "path", "phase", "duty",
-                      "duty_type", "node", "span", "error")
+                      "duty_type", "node", "span", "error", "stage", "op",
+                      "cache", "program")
 
 #: The Registry implementation itself dispatches sample values through
 #: methods with the same names (`_Hist.observe(value)`) — implementation,
-#: not call sites.
+#: not call sites.  Its LITERAL-name call sites (the scrape-time
+#: exporters: readiness, devcache, dispatch/compile gauges) still feed
+#: the catalogue-drift pass through a names-only sweep below.
 EXCLUDE_FILES = ("app/monitoring.py",)
+
+#: Where the metric catalogue lives, relative to the repo root.
+CATALOGUE_DOC = os.path.join("docs", "observability.md")
+
+#: Doc-side metric token: anything with a subsystem prefix.  Histogram
+#: expansion suffixes are normalised away when the stem is a known
+#: histogram family (alert exprs legitimately reference `_bucket`).
+_DOC_TOKEN = re.compile(r"\b((?:charon_tpu|core|app)_[a-z0-9_]+)\b")
 
 
 @dataclass
@@ -73,6 +89,10 @@ class MetricSite:
 @dataclass
 class MetricsLintReport:
     sites: list = field(default_factory=list)
+    #: literal-name sites from EXCLUDE_FILES (the Registry module's own
+    #: scrape-time exporters) — catalogue-drift input only, exempt from
+    #: the write-surface rules
+    extra_sites: list = field(default_factory=list)
     violations: list = field(default_factory=list)
 
     @property
@@ -82,6 +102,14 @@ class MetricsLintReport:
     def names(self) -> dict[str, set]:
         out: dict[str, set] = {}
         for s in self.sites:
+            out.setdefault(s.name, set()).add(s.kind)
+        return out
+
+    def exported_names(self) -> dict[str, set]:
+        """Every family the package exports (main + excluded-file
+        sites) — what the doc catalogue is checked against."""
+        out = self.names()
+        for s in self.extra_sites:
             out.setdefault(s.name, set()).add(s.kind)
         return out
 
@@ -201,11 +229,72 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_sources(sources: dict[str, str]) -> MetricsLintReport:
-    """Lint {path: python source} — the unit-testable core."""
+class _NamesOnlyVisitor(ast.NodeVisitor):
+    """Literal metric-name collector for EXCLUDE_FILES: the Registry
+    module's value-dispatch calls (`_Hist.observe(value)`) must not trip
+    the non-literal-name rule, but its exporter call sites DO export
+    families the catalogue must cover."""
+
+    def __init__(self, path: str, out: list):
+        self._path = path
+        self._out = out
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in METRIC_METHODS:
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self._out.append(MetricSite(
+                    file=self._path, line=node.lineno, name=arg.value,
+                    kind=METRIC_METHODS[fn.attr]))
+        self.generic_visit(node)
+
+
+def check_catalogue(report: MetricsLintReport, doc_text: str,
+                    doc_path: str = CATALOGUE_DOC) -> None:
+    """Catalogue-drift pass: exported families ⊆ documented names and
+    documented names ⊆ exported families.  Histogram expansion suffixes
+    in the doc (`X_bucket` in an alert expr) normalise to their stem
+    when the stem is a known histogram family."""
+    exported = report.exported_names()
+    hist_stems = {n for n, k in exported.items() if "histogram" in k}
+    documented: set[str] = set()
+    for token in _DOC_TOKEN.findall(doc_text):
+        stem = token
+        for suffix in _HIST_SUFFIXES:
+            if token.endswith(suffix) and token[: -len(suffix)] in hist_stems:
+                stem = token[: -len(suffix)]
+                break
+        documented.add(stem)
+    for name in sorted(set(exported) - documented):
+        where = sorted({f"{s.file}:{s.line}"
+                        for s in report.sites + report.extra_sites
+                        if s.name == name})[0]
+        report.violations.append(
+            f"{where}: exported metric {name!r} is missing from the "
+            f"{doc_path} catalogue — undocumented families are "
+            f"un-dashboardable; add a catalogue row")
+    for name in sorted(documented - set(exported)):
+        report.violations.append(
+            f"{doc_path}: documents metric {name!r} which no code "
+            f"exports — stale catalogue rows leave alert rules firing "
+            f"on nothing; delete the row or restore the metric")
+
+
+def lint_sources(sources: dict[str, str],
+                 catalogue_doc: str | None = None) -> MetricsLintReport:
+    """Lint {path: python source} — the unit-testable core.  When
+    `catalogue_doc` (the observability doc's text) is given, the
+    catalogue-drift pass runs too."""
     report = MetricsLintReport()
     for path, src in sorted(sources.items()):
         if path.replace(os.sep, "/").endswith(EXCLUDE_FILES):
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as exc:  # pragma: no cover - repo parses
+                report.violations.append(f"{path}: unparseable: {exc}")
+                continue
+            _NamesOnlyVisitor(path, report.extra_sites).visit(tree)
             continue
         try:
             tree = ast.parse(src, filename=path)
@@ -238,6 +327,8 @@ def lint_sources(sources: dict[str, str]) -> MetricsLintReport:
                 report.violations.append(
                     f"metric {stem + suffix!r} collides with histogram "
                     f"{stem!r}'s {suffix} series")
+    if catalogue_doc is not None:
+        check_catalogue(report, catalogue_doc)
     return report
 
 
@@ -247,7 +338,9 @@ def package_root() -> str:
 
 def lint_package(root: str | None = None) -> MetricsLintReport:
     """Lint every .py file under the charon_tpu package (tests and
-    scripts outside the package define scratch registries freely)."""
+    scripts outside the package define scratch registries freely) and
+    check the repo's metric catalogue (docs/observability.md) for
+    drift in both directions."""
     root = root or package_root()
     sources: dict[str, str] = {}
     for dirpath, dirnames, filenames in os.walk(root):
@@ -258,4 +351,9 @@ def lint_package(root: str | None = None) -> MetricsLintReport:
                 with open(path, encoding="utf-8") as f:
                     sources[os.path.relpath(path, os.path.dirname(root))] = \
                         f.read()
-    return lint_sources(sources)
+    doc_text = None
+    doc_path = os.path.join(os.path.dirname(root), CATALOGUE_DOC)
+    if os.path.exists(doc_path):
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+    return lint_sources(sources, catalogue_doc=doc_text)

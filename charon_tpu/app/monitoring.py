@@ -29,9 +29,10 @@ import shutil
 import sys
 import tarfile
 import tempfile
+import threading
 import time
 import urllib.parse
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Callable
 
 #: Default histogram bounds (seconds-scale latency): per-metric overrides
@@ -71,7 +72,14 @@ class _Hist:
 
 class Registry:
     """Minimal Prometheus-style registry: counters + gauges + fixed-bucket
-    histograms with cluster-identity constant labels."""
+    histograms with cluster-identity constant labels.
+
+    Writes and the render snapshot run under one internal lock: since
+    the dispatch stage/compile instrumentation landed, series are
+    written from the event loop, the launch thread (compile timers) and
+    read from whichever thread serves the scrape — an unlocked
+    ``defaultdict`` += or a dict resized mid-render is a lost sample or
+    a RuntimeError at exactly the moment an operator is looking."""
 
     def __init__(self, const_labels: dict | None = None):
         self.const_labels = dict(const_labels or {})
@@ -79,6 +87,7 @@ class Registry:
         self._gauges: dict[tuple, float] = {}
         self._hist: dict[tuple, _Hist] = {}
         self._buckets: dict[str, tuple] = {}
+        self._lock = threading.RLock()
 
     def _key(self, name: str, labels: dict | None) -> tuple:
         merged = {**self.const_labels, **(labels or {})}
@@ -86,46 +95,52 @@ class Registry:
 
     def inc(self, name: str, value: float = 1.0,
             labels: dict | None = None) -> None:
-        self._counters[self._key(name, labels)] += value
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
 
     def set_gauge(self, name: str, value: float,
                   labels: dict | None = None) -> None:
-        self._gauges[self._key(name, labels)] = value
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
 
     def set_buckets(self, name: str, bounds) -> None:
         """Per-metric bucket config; applies to series created after the
         call (configure at wiring time, before the first observe)."""
-        self._buckets[name] = tuple(sorted(float(b) for b in bounds))
+        with self._lock:
+            self._buckets[name] = tuple(sorted(float(b) for b in bounds))
 
     def observe(self, name: str, value: float,
                 labels: dict | None = None) -> None:
-        key = self._key(name, labels)
-        h = self._hist.get(key)
-        if h is None:
-            h = self._hist[key] = _Hist(
-                self._buckets.get(name, DEFAULT_BUCKETS))
-        h.observe(value)
+        with self._lock:
+            key = self._key(name, labels)
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = _Hist(
+                    self._buckets.get(name, DEFAULT_BUCKETS))
+            h.observe(value)
 
     def render(self) -> str:
         lines = []
-        for (name, labels), v in sorted(self._counters.items()):
-            lines.append(f"{name}{_fmt_labels(labels)} {v}")
-        for (name, labels), v in sorted(self._gauges.items()):
-            lines.append(f"{name}{_fmt_labels(labels)} {v}")
-        typed = set()
-        for (name, labels), h in sorted(self._hist.items()):
-            if name not in typed:
-                typed.add(name)
-                lines.append(f"# TYPE {name} histogram")
-            for le, acc in zip(h.bounds, h.cumulative()):
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"{name}{_fmt_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(f"{name}{_fmt_labels(labels)} {v}")
+            typed = set()
+            for (name, labels), h in sorted(self._hist.items()):
+                if name not in typed:
+                    typed.add(name)
+                    lines.append(f"# TYPE {name} histogram")
+                for le, acc in zip(h.bounds, h.cumulative()):
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(labels + (('le', _fmt_le(le)),))} "
+                        f"{acc}")
                 lines.append(
                     f"{name}_bucket"
-                    f"{_fmt_labels(labels + (('le', _fmt_le(le)),))} {acc}")
-            lines.append(
-                f"{name}_bucket"
-                f"{_fmt_labels(labels + (('le', '+Inf'),))} {h.count}")
-            lines.append(f"{name}_sum{_fmt_labels(labels)} {h.sum}")
-            lines.append(f"{name}_count{_fmt_labels(labels)} {h.count}")
+                    f"{_fmt_labels(labels + (('le', '+Inf'),))} {h.count}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} {h.sum}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} {h.count}")
         return "\n".join(lines) + "\n"
 
 
@@ -181,6 +196,12 @@ def export_devcache_metrics(registry: "Registry") -> None:
     registry.set_gauge("charon_tpu_devcache_resident",
                        1.0 if stats.get("enabled") else 0.0)
     host = be.TPUBackend.host_cache_stats()
+    # rolling hit ratio: Δhits / (Δhits + Δmisses) between scrapes —
+    # cumulative ratios flatten a sudden thrash into noise; the scrape
+    # delta is the live signal the DevCacheThrashing alert wants.  Prev
+    # snapshots live on the registry so per-node scrape cadences never
+    # interfere.
+    prev = registry.__dict__.setdefault("_devcache_prev", {})
     for cache in ("pk", "hm"):
         # one uniform schema whichever residency serves: the device
         # store when it exists, else the host LRU twin
@@ -200,6 +221,94 @@ def export_devcache_metrics(registry: "Registry") -> None:
                            s["misses"], labels=labels)
         registry.set_gauge("charon_tpu_devcache_evictions_total",
                            s["evictions"], labels=labels)
+        p_hits, p_misses = prev.get(cache, (0, 0))
+        d_hits = max(0, s["hits"] - p_hits)
+        d_misses = max(0, s["misses"] - p_misses)
+        prev[cache] = (s["hits"], s["misses"])
+        if d_hits + d_misses:
+            ratio = d_hits / (d_hits + d_misses)
+        elif s["hits"] + s["misses"]:
+            # idle window: fall back to the cumulative ratio rather
+            # than flapping the gauge to 0
+            ratio = s["hits"] / (s["hits"] + s["misses"])
+        else:
+            ratio = 0.0
+        registry.set_gauge("charon_tpu_devcache_hit_ratio", ratio,
+                           labels=labels)
+
+
+def export_dispatch_metrics(registry: "Registry") -> None:
+    """Refresh the compile-timeline and dispatch gauges at every
+    /metrics scrape (export_devcache_metrics twin): per-program XLA
+    compile counts/seconds from the TPU backend's compile tracker (the
+    ``all`` roll-up always serves, so a node that never compiled still
+    answers the CompileStorm query with 0), plus the process pipeline's
+    cumulative busy/row counters."""
+    be = sys.modules.get("charon_tpu.tbls.backend_tpu")
+    total = 0
+    if be is not None:
+        for program, st in be.compile_stats().items():
+            registry.set_gauge("app_xla_compiles_total", st["count"],
+                               labels={"program": program})
+            registry.set_gauge("app_xla_compile_total_seconds",
+                               st["total_s"], labels={"program": program})
+            total += st["count"]
+    registry.set_gauge("app_xla_compiles_total", total,
+                       labels={"program": "all"})
+    dsp = sys.modules.get("charon_tpu.tbls.dispatch")
+    pipe = dsp.current_pipeline() if dsp is not None else None
+    if pipe is not None:
+        registry.set_gauge("core_dispatch_launches_total", pipe.launches)
+        registry.set_gauge("core_dispatch_verify_rows_total",
+                           pipe.verify_rows)
+
+
+#: HBM live-bytes sampling cadence (seconds).  The gauge answers the
+#: HBMGrowth alert: a leak (arrays pinned by a stale reference, an
+#: unbounded device cache) shows as monotone growth across samples.
+HBM_SAMPLE_INTERVAL = 10.0
+
+
+def sample_hbm_live_bytes(registry: "Registry") -> int:
+    """One sample of device-resident bytes → the
+    ``charon_tpu_hbm_live_bytes`` gauge.  Prefers the backend's own
+    allocator stats (``bytes_in_use`` summed over local devices — the
+    same reader /debug/memory serves); falls back to summing
+    jax.live_arrays when the platform exposes no memory stats (CPU)."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - no jax in process
+        return 0
+    nbytes = 0
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                nbytes += int(stats["bytes_in_use"])
+    except Exception:  # noqa: BLE001 — sampling must never raise
+        nbytes = 0
+    if nbytes == 0:
+        try:
+            for a in jax.live_arrays():
+                try:
+                    nbytes += a.nbytes
+                except Exception:  # deleted/donated buffers
+                    pass
+        except Exception:  # noqa: BLE001
+            pass
+    registry.set_gauge("charon_tpu_hbm_live_bytes", nbytes)
+    return nbytes
+
+
+async def hbm_sample_loop(registry: "Registry",
+                          interval: float = HBM_SAMPLE_INTERVAL) -> None:
+    """Lifecycle background task: sample device-resident bytes into
+    ``charon_tpu_hbm_live_bytes`` every `interval` seconds (first
+    sample immediately, so short-lived simnet nodes serve the gauge
+    too).  Runs until cancelled."""
+    while True:
+        await asyncio.to_thread(sample_hbm_live_bytes, registry)
+        await asyncio.sleep(interval)
 
 
 #: Loop-lag probe buckets: the 12 s slot budget makes 1 ms–1 s the band
@@ -209,26 +318,57 @@ LOOP_LAG_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                     0.5, 1.0, 2.5)
 
 
+#: Loop-lag SLO: the dispatch pipeline's acceptance bar (p99 < 50 ms).
+LOOP_LAG_SLO_SECONDS = 0.05
+
+#: Rolling lag samples the breach detector evaluates p99 over — at the
+#: 50 ms probe interval this is a ~13 s window; breach evaluation needs
+#: at least LOOP_LAG_MIN_SAMPLES so one cold tick cannot page.
+LOOP_LAG_WINDOW = 256
+LOOP_LAG_MIN_SAMPLES = 20
+
+
 async def loop_lag_probe(registry: "Registry", interval: float = 0.05,
-                         dispatcher=None) -> None:
+                         dispatcher=None,
+                         lag_slo: float = LOOP_LAG_SLO_SECONDS,
+                         on_breach: Callable[[str], None] | None = None,
+                         ) -> None:
     """Self-timing event-loop health probe: sleep `interval`, measure how
     late the wake-up lands, and export the excess as the
     ``app_event_loop_lag_seconds`` histogram — the before/after witness
     for the off-loop dispatch pipeline (an inline multi-hundred-ms device
     launch shows up here as a multi-hundred-ms lag sample).  When a
     `tbls.dispatch.DispatchPipeline` is passed, its launch backlog is
-    exported as the ``app_dispatch_queue_depth`` gauge on every tick.
-    Runs until cancelled."""
+    exported as the ``app_dispatch_queue_depth`` gauge and its rolling
+    launch-busy fraction as ``core_dispatch_overlap_efficiency`` on
+    every tick (the LIVE production twin of bench.py's per-A/B
+    overlap_efficiency number).
+
+    SLO breach hook: when the p99 over the rolling sample window
+    exceeds `lag_slo`, `on_breach("loop_lag")` fires once per breached
+    tick — wire it to the auto-profiler, whose own rate limit bounds
+    capture frequency.  Runs until cancelled."""
     registry.set_buckets("app_event_loop_lag_seconds", LOOP_LAG_BUCKETS)
     loop = asyncio.get_running_loop()
+    lags: deque = deque(maxlen=LOOP_LAG_WINDOW)
     while True:
         t0 = loop.time()
         await asyncio.sleep(interval)
         lag = max(0.0, loop.time() - t0 - interval)
         registry.observe("app_event_loop_lag_seconds", lag)
+        lags.append(lag)
         if dispatcher is not None:
             registry.set_gauge("app_dispatch_queue_depth",
                                dispatcher.queue_depth)
+            registry.set_gauge("core_dispatch_overlap_efficiency",
+                               dispatcher.overlap_efficiency())
+        if on_breach is not None and len(lags) >= LOOP_LAG_MIN_SAMPLES:
+            p99 = sorted(lags)[int(0.99 * (len(lags) - 1))]
+            if p99 > lag_slo:
+                try:
+                    on_breach("loop_lag")
+                except Exception:  # noqa: BLE001 — probe must not die
+                    pass
 
 
 PROFILE_MAX_SECONDS = 30.0
@@ -236,8 +376,52 @@ PROFILE_MAX_SECONDS = 30.0
 #: jax.profiler trace state is PROCESS-global, so the in-flight guard
 #: must be too: with several in-process nodes (simnet), concurrent
 #: /debug/profile requests to different nodes' APIs still race one
-#: profiler.
+#: profiler.  The SLO-triggered auto-profiler (app/autoprofile.py)
+#: shares THIS guard through acquire/release, so a watchdog capture and
+#: a manual /debug/profile can never double-start the profiler.
 _PROFILE_ACTIVE = False
+_PROFILE_GUARD_LOCK = threading.Lock()
+
+
+def profile_guard_acquire() -> bool:
+    """Claim the process-global profiler; False = a capture is already
+    running (callers must skip, not queue — jax.profiler state is
+    process-wide)."""
+    global _PROFILE_ACTIVE
+    with _PROFILE_GUARD_LOCK:
+        if _PROFILE_ACTIVE:
+            return False
+        _PROFILE_ACTIVE = True
+        return True
+
+
+def profile_guard_release() -> None:
+    global _PROFILE_ACTIVE
+    with _PROFILE_GUARD_LOCK:
+        _PROFILE_ACTIVE = False
+
+
+async def run_profile_capture(out_dir: str, seconds: float) -> None:
+    """ONE copy of the jax.profiler capture protocol — shared by the
+    /debug/profile handler and the SLO auto-profiler
+    (app/autoprofile.py), so the sleep cadence and the token device op
+    cannot drift between the two surfaces.  Caller owns the profiler
+    guard and the output directory."""
+    import jax
+
+    jax.profiler.start_trace(out_dir)
+    try:
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            await asyncio.sleep(
+                min(0.1, max(deadline - time.monotonic(), 0)))
+        # a token device op so an idle node still yields a non-empty
+        # capture (and the device plane appears)
+        import jax.numpy as jnp
+
+        (jnp.arange(128, dtype=jnp.int32) + 1).block_until_ready()
+    finally:
+        jax.profiler.stop_trace()
 
 
 class MonitoringAPI:
@@ -302,6 +486,10 @@ class MonitoringAPI:
                 pass
             try:
                 export_devcache_metrics(self.registry)
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                pass
+            try:
+                export_dispatch_metrics(self.registry)
             except Exception:  # noqa: BLE001 — scrape must not 500
                 pass
             return ("200 OK", METRICS_CONTENT_TYPE,
@@ -382,6 +570,19 @@ class MonitoringAPI:
             # round-12 residency story, answerable from /debug/memory
             info["devcache"] = be.TPUBackend.devcache_stats()
             info["resident_graph_keys"] = be.resident_graph_keys()
+            # per-program XLA compile timeline: counts + first/last/total
+            # seconds per fused-graph key, plus the raw "xla" aggregate
+            # — the /debug twin of app_xla_compiles_total{program}
+            info["compile_programs"] = be.compile_stats()
+        dsp = sys.modules.get("charon_tpu.tbls.dispatch")
+        pipe = dsp.current_pipeline() if dsp is not None else None
+        if pipe is not None:
+            # dispatch executor health: launch backlog, prewarm report,
+            # cumulative per-(op, stage) seconds and the live overlap
+            # gauge — the same decomposition /metrics serves, queryable
+            # without a scraper
+            info["dispatch"] = pipe.stage_stats()
+            info["dispatch"]["prewarmed"] = pipe.prewarmed
         if self._tracer is not None:
             info["tracer"] = {"spans_buffered": len(self._tracer.spans),
                               "dropped_spans": self._tracer.dropped}
@@ -404,30 +605,21 @@ class MonitoringAPI:
             return ("400 Bad Request", "text/plain",
                     b"seconds must be a number")
         seconds = min(max(seconds, 0.0), PROFILE_MAX_SECONDS)
-        global _PROFILE_ACTIVE
-        if _PROFILE_ACTIVE:
-            return ("409 Conflict", "text/plain",
-                    b"a profile capture is already running")
         try:
-            import jax
+            import jax  # noqa: F401 — availability probe only
         except Exception:  # pragma: no cover - no jax in process
             return ("501 Not Implemented", "text/plain", b"jax unavailable")
-        _PROFILE_ACTIVE = True
-        tmp = tempfile.mkdtemp(prefix="charon-tpu-profile-")
+        if not profile_guard_acquire():
+            return ("409 Conflict", "text/plain",
+                    b"a profile capture is already running")
+        tmp = None
         try:
-            jax.profiler.start_trace(tmp)
-            try:
-                deadline = time.monotonic() + seconds
-                while time.monotonic() < deadline:
-                    await asyncio.sleep(
-                        min(0.1, max(deadline - time.monotonic(), 0)))
-                # a token device op so an idle node still yields a
-                # non-empty capture (and the device plane appears)
-                import jax.numpy as jnp
-
-                (jnp.arange(128, dtype=jnp.int32) + 1).block_until_ready()
-            finally:
-                jax.profiler.stop_trace()
+            # INSIDE the guard's try: a failing mkdtemp (full /tmp,
+            # unwritable TMPDIR) must still release the process-global
+            # guard, or manual AND SLO-triggered profiling stay dead
+            # until restart
+            tmp = tempfile.mkdtemp(prefix="charon-tpu-profile-")
+            await run_profile_capture(tmp, seconds)
             buf = io.BytesIO()
             with tarfile.open(fileobj=buf, mode="w:gz") as tar:
                 tar.add(tmp, arcname="profile")
@@ -436,5 +628,6 @@ class MonitoringAPI:
             return ("500 Internal Server Error", "text/plain",
                     f"profile capture failed: {exc}".encode())
         finally:
-            _PROFILE_ACTIVE = False
-            shutil.rmtree(tmp, ignore_errors=True)
+            profile_guard_release()
+            if tmp is not None:
+                shutil.rmtree(tmp, ignore_errors=True)

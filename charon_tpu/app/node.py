@@ -28,8 +28,9 @@ from ..core.validatorapi import ValidatorAPI
 from ..core.verify import BatchVerifier
 from ..eth2util.signing import signing_root
 from ..tbls import dispatch
-from .monitoring import loop_lag_probe
-from .tracing import Tracer, with_tracing
+from . import autoprofile
+from .monitoring import hbm_sample_loop, loop_lag_probe
+from .tracing import Tracer, duty_trace_id, with_tracing
 
 
 @dataclass
@@ -96,6 +97,21 @@ class Node:
         self._genesis_time = genesis_time
         self._slot_duration = slot_duration
 
+        # Per-stage dispatch attribution: register this node's registry
+        # with the process-global fan-out so the simnet serves the same
+        # core_dispatch_stage_seconds{stage,op} / app_xla_compile_seconds
+        # families as production (shared pipeline → shared series, the
+        # accepted in-process multi-node approximation).
+        if registry is not None and self.dispatcher is not None:
+            dispatch.add_metrics_registry(registry)
+
+        # SLO-triggered auto-profiler (opt-in for test simnets:
+        # CHARON_TPU_AUTOPROFILE=1 — real jax.profiler captures inside
+        # tier-1 would race the /debug/profile tests' process guard).
+        self.autoprofiler = autoprofile.from_env(
+            registry=registry, node_name=f"node{cfg.share_idx - 1}",
+            default_on=False)
+
         # Slot-budget accountant: hand-off hooks subscribe BEFORE wire()
         # so each timestamp is taken before the downstream edge runs
         # (the threshold→sigagg edge awaits the whole combine otherwise).
@@ -113,6 +129,11 @@ class Node:
             self.parsigdb.subscribe_threshold(self.slotbudget.on_threshold)
             self.sigagg.subscribe(self.slotbudget.on_aggregated)
             self.bcast.subscribe(self.slotbudget.on_broadcast)
+            if self.autoprofiler is not None:
+                # late-duty watchdog → bounded auto-capture stamped with
+                # the duty's deterministic trace ID
+                self.slotbudget.subscribe_late(self.autoprofiler.make_hook(
+                    "late_duty", trace_id_fn=duty_trace_id))
 
         interfaces.wire(self.scheduler, self.fetcher, self.consensus,
                         self.dutydb, self.vapi, self.parsigdb, self.parsigex,
@@ -154,6 +175,7 @@ class Node:
         self._run_task: asyncio.Task | None = None
         self._gc_task: asyncio.Task | None = None
         self._lag_task: asyncio.Task | None = None
+        self._hbm_task: asyncio.Task | None = None
 
     async def _verify_external(self, duty: Duty,
                                pset: ParSignedDataSet) -> None:
@@ -195,11 +217,21 @@ class Node:
         self._run_task = loop.create_task(self.scheduler.run())
         if self.registry is not None:
             # event-loop health: the simnet node exports the same
-            # app_event_loop_lag_seconds / dispatch queue-depth families
-            # as the full App, so loop-responsiveness tests run without
-            # the TCP/crypto stack
+            # app_event_loop_lag_seconds / dispatch queue-depth /
+            # overlap-efficiency families as the full App, so
+            # loop-responsiveness tests run without the TCP/crypto
+            # stack; the loop-lag SLO breach feeds the auto-profiler
+            # when one is wired
+            breach = (self.autoprofiler.make_hook("loop_lag")
+                      if self.autoprofiler is not None else None)
             self._lag_task = loop.create_task(
-                loop_lag_probe(self.registry, dispatcher=self.dispatcher))
+                loop_lag_probe(self.registry, dispatcher=self.dispatcher,
+                               on_breach=breach))
+            # HBM live-bytes sampling (charon_tpu_hbm_live_bytes), same
+            # reader as /debug/memory — short interval so short-lived
+            # simnet nodes serve the gauge too
+            self._hbm_task = loop.create_task(
+                hbm_sample_loop(self.registry, interval=5.0))
         if self.tracker is not None:
             self.deadliner = Deadliner(
                 lambda d: duty_deadline(d, self._genesis_time,
@@ -217,3 +249,7 @@ class Node:
             self._gc_task.cancel()
         if self._lag_task is not None:
             self._lag_task.cancel()
+        if self._hbm_task is not None:
+            self._hbm_task.cancel()
+        if self.registry is not None:
+            dispatch.remove_metrics_registry(self.registry)

@@ -48,10 +48,10 @@ from ..p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
 from ..p2p.transport import TCPMesh, mesh_params_from_definition
 from ..tbls import api as tbls
 from ..tbls import dispatch
-from . import featureset, log as applog, otlp, tracing
+from . import autoprofile, featureset, log as applog, otlp, tracing
 from .lifecycle import Manager, StartOrder, StopOrder
-from .monitoring import (MonitoringAPI, Registry, loop_lag_probe,
-                         set_readiness)
+from .monitoring import (MonitoringAPI, Registry, hbm_sample_loop,
+                         loop_lag_probe, set_readiness)
 from .qbftdebug import QBFTSniffer
 from .peerinfo import PeerInfo
 from .retry import Retryer, with_async_retry
@@ -167,6 +167,12 @@ class App:
         self.registry.set_buckets(
             "charon_tpu_tracker_inclusion_delay",
             (0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+        # per-stage dispatch attribution + compile histograms from the
+        # process-global fan-out (tbls/dispatch.py): register this
+        # node's registry so core_dispatch_stage_seconds{stage,op} and
+        # app_xla_compile_seconds (compile bucket ladder configured at
+        # registration) land on OUR /metrics
+        dispatch.add_metrics_registry(self.registry)
 
         # 5b. duty tracer + OTLP export sinks (reference: app/tracer/
         #     trace.go:40-151).  The tracer is created before the core
@@ -272,6 +278,17 @@ class App:
         parsigdb.subscribe_threshold(self.slotbudget.on_threshold)
         sigagg.subscribe(self.slotbudget.on_aggregated)
         bcast.subscribe(self.slotbudget.on_broadcast)
+
+        # 7c. SLO-triggered auto-profiler: a late-duty watchdog trip or
+        #     a loop-lag p99 breach captures a bounded, rate-limited
+        #     jax.profiler trace stamped with the duty's trace ID — the
+        #     operator gets the device timeline OF the slow slot, not a
+        #     post-hoc guess (CHARON_TPU_AUTOPROFILE knobs).
+        self.autoprofiler = autoprofile.from_env(
+            registry=self.registry, node_name=node_name, default_on=True)
+        if self.autoprofiler is not None:
+            self.slotbudget.subscribe_late(self.autoprofiler.make_hook(
+                "late_duty", trace_id_fn=tracing.duty_trace_id))
 
         interfaces.wire(sched, fetcher, consensus, dutydb, vapi, parsigdb,
                         parsigex, sigagg, aggsigdb, bcast,
@@ -389,6 +406,13 @@ class App:
             # fused→jnp fallback (tbls/backend_tpu) shows up here
             self.registry.set_gauge("core_verify_launches_by_path", count,
                                     labels={"path": path})
+        for path, rate in v.rows_per_s_by_path.items():
+            # live verify throughput per pairing path (wall-clocked
+            # around the awaited launch) — the production twin of
+            # bench.py's sigs_per_s, so the 10k-sigs/s gap (ROADMAP
+            # item 2) is measurable in place
+            self.registry.set_gauge("core_verify_rows_per_s", rate,
+                                    labels={"path": path})
 
     async def _pubkey_by_index(self, index: int) -> PubKey:
         if not self._index_to_pubkey:
@@ -486,10 +510,20 @@ class App:
             await asyncio.sleep(self.cfg.ping_interval)
 
     async def _loop_lag_probe(self) -> None:
-        """Event-loop health self-probe: `app_event_loop_lag_seconds` +
-        the dispatch queue-depth gauge — the before/after witness that
-        device launches really run off-loop."""
-        await loop_lag_probe(self.registry, dispatcher=self.dispatcher)
+        """Event-loop health self-probe: `app_event_loop_lag_seconds`,
+        the dispatch queue-depth gauge and the live overlap-efficiency
+        gauge — plus the loop-lag SLO breach hook into the
+        auto-profiler (its rate limit bounds capture frequency)."""
+        breach = (self.autoprofiler.make_hook("loop_lag")
+                  if self.autoprofiler is not None else None)
+        await loop_lag_probe(self.registry, dispatcher=self.dispatcher,
+                             on_breach=breach)
+
+    async def _hbm_probe(self) -> None:
+        """Device-memory growth witness: `charon_tpu_hbm_live_bytes`
+        sampled on a lifecycle background task (the HBMGrowth alert's
+        series — /debug/memory serves the same reader on demand)."""
+        await hbm_sample_loop(self.registry)
 
     async def _dispatch_prewarm(self) -> None:
         """Boot-time shape prewarm (CHARON_TPU_DISPATCH_PREWARM): compile
@@ -555,6 +589,8 @@ class App:
                             self._start_monitoring)
         life.register_start(StartOrder.MONITOR_API, "loop-lag-probe",
                             self._loop_lag_probe, background=True)
+        life.register_start(StartOrder.MONITOR_API, "hbm-probe",
+                            self._hbm_probe, background=True)
         # background, and on a DEDICATED prewarm thread (not the launch
         # pool — see DispatchPipeline.prewarm): first duties' launches
         # are never queued behind the big (V, T) compiles; a duty that
@@ -594,6 +630,9 @@ class App:
     async def _stop_monitoring(self) -> None:
         await self.monitoring.stop()
         self.deadliner.stop()
+        # detach from the dispatch metrics fan-out (other Apps in this
+        # process keep theirs)
+        dispatch.remove_metrics_registry(self.registry)
         for sink in self._otlp_sinks:
             # final drain: FileSink flushes sync, AsyncHTTPSink async
             if hasattr(sink, "aclose"):

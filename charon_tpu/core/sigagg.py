@@ -98,13 +98,19 @@ class SigAgg:
             padded_rows=tbls.combine_padded_rows(len(batch), t),
             queue_depth=pipe.queue_depth if pipe is not None else -1)
             if self._tracer is not None else contextlib.nullcontext())
+        stage_stats: dict = {}
         try:
-            with span:
+            with span as sp:
                 if pipe is None:    # CHARON_TPU_DISPATCH=0: legacy inline
                     combined = tbls.threshold_combine(sig_sets)
                 else:
                     # ONE coalesced launch, awaited off-loop
-                    combined = await pipe.threshold_combine(sig_sets)
+                    combined = await pipe.threshold_combine(
+                        sig_sets, stats=stage_stats)
+                # queue-wait / host-prep / device-exec / fetch span attrs
+                # (same decomposition as core_dispatch_stage_seconds)
+                if sp is not None and stage_stats:
+                    sp.attrs.update(dispatch.stage_span_attrs(stage_stats))
         except Exception as exc:
             for item in batch:
                 if not item.done.done():

@@ -73,6 +73,15 @@ class SlotBudget:
         self._max = max_duties
         self._events: "OrderedDict[Duty, dict[str, float]]" = OrderedDict()
         self.late_duties = 0
+        self._late_hooks: list = []
+
+    def subscribe_late(self, fn) -> None:
+        """fn(duty, responsible_phase) fires SYNCHRONOUSLY whenever the
+        late-duty watchdog trips — the SLO hook the auto-profiler
+        (app/autoprofile.py) hangs off, so a breach captures its own
+        device trace.  Hook failures are swallowed: telemetry reacting
+        to a late duty must never make the duty pipeline later."""
+        self._late_hooks.append(fn)
 
     # -- event hooks (subscribe before core.wire) ---------------------------
 
@@ -165,4 +174,12 @@ class SlotBudget:
         if reg is not None:
             reg.inc("core_slot_late_duties_total",
                     labels={"phase": responsible})
+        for fn in self._late_hooks:
+            try:
+                fn(duty, responsible)
+            except Exception:  # noqa: BLE001 — see subscribe_late
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "late-duty watchdog hook raised")
         return phases

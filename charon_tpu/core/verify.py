@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
 from dataclasses import dataclass, field
 
 from ..tbls import api as tbls
@@ -85,6 +86,11 @@ class BatchVerifier:
         # were queued — rows-per-launch efficacy for bench/metrics
         self.packed_flushes = 0
         self.packed_entries = 0
+        # rows-per-second of the most recent launch, per verify_path
+        # label (wall-clocked around the awaited pipeline call) —
+        # exported as core_verify_rows_per_s{path} by the app wiring,
+        # the live throughput twin of bench.py's `sigs_per_s` numbers
+        self.rows_per_s_by_path: dict = {}
         self._draining = False
 
     async def verify(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
@@ -161,21 +167,30 @@ class BatchVerifier:
         sizes = (pipe.plan_verify(len(flat)) if pipe is not None
                  else [len(flat)])
         tile_paths = [tbls.verify_path(s) for s in sizes]
+        path_label = "+".join(sorted(set(tile_paths)))
         span = (self._tracer.start_span(
             "tpu/batch_verify", batch=len(flat),
-            path="+".join(sorted(set(tile_paths))),
+            path=path_label,
             padded_rows=sum(tbls.verify_padded_rows(s) for s in sizes),
             coalesced_calls=len(batch), tiles=len(sizes),
             queue_depth=pipe.queue_depth if pipe is not None else -1)
             if self._tracer is not None else contextlib.nullcontext())
+        stage_stats: dict = {}
         try:
-            with span:
+            with span as sp:
+                t0 = time.perf_counter()
                 if pipe is None:    # CHARON_TPU_DISPATCH=0: legacy inline
                     oks = tbls.batch_verify(flat)
                 else:
                     # ONE coalesced launch unit, awaited off-loop (tiled
                     # into pipelined sub-launches above the dispatch tile)
-                    oks = await pipe.batch_verify(flat)
+                    oks = await pipe.batch_verify(flat, stats=stage_stats)
+                wall = time.perf_counter() - t0
+                # per-stage decomposition (queue-wait / host-prep /
+                # device-exec / fetch, summed over tiles) rides the same
+                # span the operators already watch
+                if sp is not None and stage_stats:
+                    sp.attrs.update(dispatch.stage_span_attrs(stage_stats))
         except Exception as exc:
             for item in batch:
                 if not item.done.done():
@@ -184,6 +199,8 @@ class BatchVerifier:
         self.launches += 1
         self.entries_total += len(flat)
         self.max_batch = max(self.max_batch, len(flat))
+        if wall > 0:
+            self.rows_per_s_by_path[path_label] = len(flat) / wall
         for path in tile_paths:     # one count per sub-launch tile
             self.paths[path] = self.paths.get(path, 0) + 1
         pos = 0

@@ -676,6 +676,116 @@ def resident_graph_args(kind: str, v: int) -> tuple:
     return args
 
 
+# ---------------------------------------------------------------------------
+# Compile timeline (ROADMAP item 2 telemetry: perf regressions in the
+# compile story — a CompileStorm from a shape leak, a multi-second
+# first-duty compile prewarm should have eaten — must be visible on
+# /metrics, not only in a bench log).
+# ---------------------------------------------------------------------------
+
+#: cumulative per-program compile stats: program → {count, total_s,
+#: first_s, last_s}.  Programs are the fused-graph cache keys
+#: (``resident:fused:v=2048``) plus the ``xla`` aggregate fed by jax's
+#: own backend-compile monitoring events (every XLA compile in the
+#: process, including the staged jit kernels).
+_COMPILE_STATS: dict[str, dict] = {}
+_COMPILE_LOCK = threading.Lock()
+
+
+def _note_compile(program: str, seconds: float,
+                  observe: bool = True) -> None:
+    with _COMPILE_LOCK:
+        st = _COMPILE_STATS.setdefault(
+            program, {"count": 0, "total_s": 0.0, "first_s": None,
+                      "last_s": None})
+        st["count"] += 1
+        st["total_s"] = round(st["total_s"] + seconds, 4)
+        st["last_s"] = round(seconds, 4)
+        if st["first_s"] is None:
+            st["first_s"] = round(seconds, 4)
+    if observe:
+        # first-call latency per fused-graph key → the
+        # app_xla_compile_seconds histogram on every registered node
+        # registry (the per-program counts ride /metrics as
+        # app_xla_compiles_total{program} gauges, scrape-refreshed)
+        for reg in dispatch.metrics_registries():
+            reg.observe("app_xla_compile_seconds", seconds)
+
+
+def compile_stats() -> dict:
+    """Snapshot of the per-program compile timeline (served at
+    /debug/memory and exported at every /metrics scrape)."""
+    with _COMPILE_LOCK:
+        return {program: dict(st)
+                for program, st in sorted(_COMPILE_STATS.items())}
+
+
+class _CompileTimed:
+    """First-call timer around a jitted program with ONE shape bucket
+    per instance: jax compiles at the first call, so the first-call
+    wall time IS the cold XLA compile (+ one execution, which is noise
+    next to a multi-second compile).  Transparent otherwise.
+
+    The first-call claim is a compare-and-set under a lock: the prewarm
+    thread and the launch thread may race the same graph's first call
+    (the prewarm docstring explicitly allows that), and two unsynced
+    timers would record the one cold compile twice — inflating the
+    CompileStorm signal."""
+
+    __slots__ = ("_fn", "_program", "_seen", "_lock")
+
+    def __init__(self, fn, program: str):
+        self._fn = fn
+        self._program = program
+        self._seen = False
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        if self._seen:
+            return self._fn(*args, **kwargs)
+        with self._lock:
+            claimed = not self._seen
+            self._seen = True
+        if not claimed:
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        _note_compile(self._program, time.perf_counter() - t0)
+        return out
+
+
+_JAX_COMPILE_LISTENER = False
+
+
+def _install_compile_listener() -> None:
+    """Count every raw XLA backend compile in the process through jax's
+    monitoring events (program label ``xla``) — the catch-all behind
+    the per-graph-key timers, so a compile STORM from an unbucketed
+    shape leak is visible even when no fused graph is involved.  Count
+    only (observe=False): the per-key timers already feed the
+    histogram, and the resident compiles would otherwise double-sample."""
+    global _JAX_COMPILE_LISTENER
+    if _JAX_COMPILE_LISTENER:
+        return
+    try:
+        from jax import monitoring as _jax_monitoring
+
+        def _on_event(event, duration, **kwargs):  # noqa: ANN001
+            try:
+                if "compile" in str(event):
+                    _note_compile("xla", float(duration), observe=False)
+            except Exception:  # noqa: BLE001 — never break a compile
+                pass
+
+        _jax_monitoring.register_event_duration_secs_listener(_on_event)
+        _JAX_COMPILE_LISTENER = True
+    except Exception:  # noqa: BLE001 — older jax without monitoring
+        pass
+
+
+_install_compile_listener()
+
+
 #: compiled resident graphs per (kind, padded-V) — explicit dict rather
 #: than lru_cache so /debug/memory can report the live compile-cache
 #: keys (`resident_graph_keys`).
@@ -695,8 +805,9 @@ def _resident_graph(kind: str, v: int):
         # raises (pinned by tests/test_tbls_devcache.py).  The limb-
         # plane uploads have no bool output to alias — they simply die
         # inside the fused graph (no host round-trip keeps a copy).
-        fn = jax.jit(_resident_verify_graph_body(kind, v),
-                     donate_argnums=(6,))
+        fn = _CompileTimed(jax.jit(_resident_verify_graph_body(kind, v),
+                                   donate_argnums=(6,)),
+                           f"resident:{kind}:v={v}")
         _RESIDENT_GRAPHS[key] = fn
     return fn
 
@@ -710,7 +821,8 @@ def _resident_recheck_graph(v: int):
     key = ("recheck", v)
     fn = _RESIDENT_GRAPHS.get(key)
     if fn is None:
-        fn = jax.jit(_resident_verify_graph_body("jnp", v))
+        fn = _CompileTimed(jax.jit(_resident_verify_graph_body("jnp", v)),
+                           f"resident:recheck:v={v}")
         _RESIDENT_GRAPHS[key] = fn
     return fn
 

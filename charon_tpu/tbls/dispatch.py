@@ -56,6 +56,18 @@ verify graph; prep shrinks to cache-slot gathering + miss packing) and
 ``CHARON_TPU_DEVCACHE_MB`` (the HBM residency allowance,
 `ops.vmem_budget.devcache_capacity_rows`).
 
+Telemetry (round 13): every job is attributed to queue_wait /
+host_prep / device_exec / fetch stages (`STAGES`), recorded into the
+``core_dispatch_stage_seconds{stage,op}`` histograms of every registry
+registered via :func:`add_metrics_registry` (the process-global fan-out
+the App/simnet Node wire — exact for production's one-node-per-process,
+a shared-series approximation for in-process multi-node tests), folded
+into cumulative per-(op, stage) counters served at /debug/memory, and
+optionally aggregated into a caller-supplied ``stats`` dict so the
+`tpu/*` spans carry the same decomposition.  A rolling launch-busy
+window serves :meth:`DispatchPipeline.overlap_efficiency` — the LIVE
+production twin of bench.py's ``overlap_efficiency`` A/B number.
+
 This module is stdlib-only (no jax import) so the guard and knobs are
 usable from any layer without dragging the device stack in.
 """
@@ -66,12 +78,14 @@ import asyncio
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 __all__ = [
-    "DispatchPipeline", "assert_off_loop", "default_pipeline",
-    "dispatch_enabled", "loop_guard_enabled", "prewarm_enabled",
-    "verify_tile_size",
+    "DispatchPipeline", "add_metrics_registry", "assert_off_loop",
+    "current_pipeline", "default_pipeline", "dispatch_enabled",
+    "loop_guard_enabled", "metrics_registries", "prewarm_enabled",
+    "remove_metrics_registry", "verify_tile_size",
 ]
 
 
@@ -137,8 +151,79 @@ def assert_off_loop(op: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Process-global metrics fan-out
+# ---------------------------------------------------------------------------
+#
+# The pipeline (and the TPU backend's compile tracker) live BELOW the app
+# layer, but their per-stage timings belong on every node's /metrics.
+# App/Node wiring registers monitoring Registries here; instrumentation
+# call-sites fan each observation out to all of them with LITERAL metric
+# names (so analysis/metrics_lint sees every family).  Like the global
+# tracer, this is exact for production (one node per process) and an
+# accepted shared-series approximation for in-process multi-node tests
+# (the nodes share the one process pipeline anyway).
+
+_metrics_registries: tuple = ()
+_metrics_lock = threading.Lock()
+
+#: Cold XLA compiles run seconds-to-minutes — the monitoring default
+#: sub-10 s latency ladder would dump every compile in +Inf.  Applied
+#: at registration so EVERY surface observing the fan-out (production
+#: App, simnet Node, tests) exports one bucket schema for the family.
+XLA_COMPILE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                       60.0, 120.0)
+
+
+def add_metrics_registry(registry) -> None:
+    """Register a monitoring Registry to receive dispatch/compile
+    observations (idempotent)."""
+    global _metrics_registries
+    try:
+        registry.set_buckets("app_xla_compile_seconds",
+                             XLA_COMPILE_BUCKETS)
+    except AttributeError:  # duck-typed test registries without buckets
+        pass
+    with _metrics_lock:
+        if registry not in _metrics_registries:
+            _metrics_registries = _metrics_registries + (registry,)
+
+
+def remove_metrics_registry(registry) -> None:
+    global _metrics_registries
+    with _metrics_lock:
+        _metrics_registries = tuple(
+            r for r in _metrics_registries if r is not registry)
+
+
+def metrics_registries() -> tuple:
+    """Snapshot of the registered registries (atomic tuple swap, so
+    readers never need the lock)."""
+    return _metrics_registries
+
+
+# ---------------------------------------------------------------------------
 # The pipeline
 # ---------------------------------------------------------------------------
+
+#: Per-job pipeline stages, in hand-off order: time waiting in the two
+#: executor queues, the host-prep callable, the device launch (jit'd
+#: kernels + result fetch to host), and the hand-back to the awaiting
+#: event loop (future resolution latency — a congested loop shows up
+#: HERE, not in device_exec).
+STAGES = ("queue_wait", "host_prep", "device_exec", "fetch")
+
+#: Sliding window (seconds) for the live overlap-efficiency gauge.
+OVERLAP_WINDOW_S = 60.0
+
+
+def stage_span_attrs(stats: dict) -> dict:
+    """A pipeline ``stats`` aggregate as span attributes: seconds
+    rounded for readability, counters (``tiles``) verbatim.  ONE copy —
+    both `tpu/batch_verify` and `tpu/threshold_combine` fold through
+    here, so the two spans' stage attrs cannot drift."""
+    return {k: round(v, 6) if k.endswith("_s") else v
+            for k, v in stats.items()}
+
 
 class DispatchPipeline:
     """Two-stage (host-prep → device-launch) executor pipeline.
@@ -146,27 +231,37 @@ class DispatchPipeline:
     Single-thread stages give strict per-stage FIFO ordering — results
     can never be delivered to the wrong awaiter because every call holds
     its own future chain — while still double-buffering: stage threads
-    work on DIFFERENT batches concurrently.  The busy-seconds/launch
-    counters each have a single writer thread; `queue_depth` has two
-    (submit on the loop thread, drain on the launch thread) and is
-    lock-protected.  /metrics exporters read everything racily, which
-    is fine for gauges.
+    work on DIFFERENT batches concurrently.
+
+    Every shared counter — ``queue_depth`` (loop-thread submit vs
+    launch-thread drain), the busy-seconds/stage accumulators (prep
+    thread vs launch thread) and the rolling launch-busy window (launch
+    thread append vs /metrics-scrape read) — is mutated and snapshotted
+    under ONE ``_lock``: three threads touch them, and an unlocked
+    ``+=`` or a deque trimmed mid-``sum()`` loses updates exactly when
+    the telemetry matters most (pinned by the concurrent-scrape test).
+
+    Per-job stage attribution (`STAGES`) is recorded into each job dict
+    by the stage that ran it (thread-local writes), folded into the
+    cumulative counters + the ``core_dispatch_stage_seconds{stage,op}``
+    histograms on the awaiting event loop after the job completes, and
+    optionally aggregated into a caller-supplied ``stats`` dict so the
+    `tpu/batch_verify` / `tpu/threshold_combine` spans can carry the
+    same decomposition as span attributes.
     """
 
-    def __init__(self, tile: int | None = None):
+    def __init__(self, tile: int | None = None,
+                 window: float = OVERLAP_WINDOW_S):
         self._tile = tile
         self._prep_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="charon-tpu-host-prep")
         self._launch_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="charon-tpu-launch")
         #: launch-stage jobs submitted but not yet finished — the
-        #: ``app_dispatch_queue_depth`` gauge.  Incremented on the
-        #: event-loop thread at submit, decremented on the launch
-        #: thread, so the read-modify-write needs the lock (a bare
-        #: ``+=`` across threads loses updates and the gauge drifts —
-        #: it feeds the EventLoopStalling alert triage).
+        #: ``app_dispatch_queue_depth`` gauge (feeds the
+        #: EventLoopStalling alert triage).
         self.queue_depth = 0
-        self._depth_lock = threading.Lock()
+        self._lock = threading.Lock()
         #: cumulative wall seconds per stage: overlap efficiency in a
         #: window is device_busy_s delta / wall delta (bench.py A/B)
         self.prep_busy_s = 0.0
@@ -176,6 +271,14 @@ class DispatchPipeline:
         #: (verify_rows / launches over a window) is the cross-duty
         #: packing efficacy the round-12 bench reports
         self.verify_rows = 0
+        #: cumulative seconds per (op, stage) — /debug/memory snapshot
+        #: of the same decomposition the histograms serve
+        self.stage_seconds: dict[tuple[str, str], float] = {}
+        #: rolling (end_ts, busy_s) launch samples inside `window` —
+        #: the live ``core_dispatch_overlap_efficiency`` gauge
+        self._window = max(1e-3, float(window))
+        self._busy_window: deque[tuple[float, float]] = deque()
+        self._created_at = time.perf_counter()
         self.prewarmed: dict | None = None
 
     # -- stage plumbing ------------------------------------------------------
@@ -183,27 +286,117 @@ class DispatchPipeline:
     def _tile_of(self) -> int:
         return verify_tile_size() if self._tile is None else self._tile
 
-    def _run_prep(self, fn, payload):
+    def _run_prep(self, fn, payload, job: dict):
         t0 = time.perf_counter()
+        job["prep_wait_s"] = t0 - job["t_submit"]
         try:
             return fn(payload)
         finally:
-            self.prep_busy_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            job["host_prep_s"] = dt
+            with self._lock:
+                self.prep_busy_s += dt
 
     def _bump_depth(self, delta: int) -> None:
-        with self._depth_lock:
+        with self._lock:
             self.queue_depth += delta
 
-    def _run_launch(self, fn, prepared):
+    def _run_launch(self, fn, prepared, job: dict):
         t0 = time.perf_counter()
+        job["launch_wait_s"] = t0 - job["t_enq_launch"]
         try:
             return fn(prepared)
         finally:
-            self.device_busy_s += time.perf_counter() - t0
-            self.launches += 1
-            self._bump_depth(-1)
+            t1 = time.perf_counter()
+            dt = t1 - t0
+            job["device_exec_s"] = dt
+            job["t_exec_end"] = t1
+            with self._lock:
+                self.device_busy_s += dt
+                self.launches += 1
+                self.queue_depth -= 1
+                self._busy_window.append((t1, dt))
+                self._trim_window_locked(t1)
 
-    async def _pipelined(self, stages, payloads) -> list:
+    def _trim_window_locked(self, now: float) -> None:
+        cutoff = now - self._window
+        while self._busy_window and self._busy_window[0][0] < cutoff:
+            self._busy_window.popleft()
+
+    def overlap_efficiency(self) -> float:
+        """Launch-thread busy fraction over the sliding window — the
+        LIVE production twin of bench.py's per-A/B `overlap_efficiency`
+        number (device-busy seconds / wall seconds).  0.0 on an idle
+        pipeline; approaching 1.0 means the launch thread never waits
+        on host prep (full double-buffering).  The denominator is the
+        pipeline's LIFETIME while younger than the window — a node 10 s
+        after boot with a fully busy launch thread reports ~1.0, not
+        10/60 (which would read as a startup overlap regression)."""
+        now = time.perf_counter()
+        with self._lock:
+            self._trim_window_locked(now)
+            busy = sum(b for _, b in self._busy_window)
+        span = max(1e-3, min(self._window, now - self._created_at))
+        return min(1.0, busy / span)
+
+    async def _finish(self, fut, job: dict):
+        """Await one launch future on the loop and stamp the hand-back
+        ('fetch') latency: exec-thread completion → loop resumption."""
+        try:
+            return await fut
+        finally:
+            end = job.get("t_exec_end")
+            if end is not None:
+                job["fetch_s"] = time.perf_counter() - end
+
+    def _record_job(self, op: str, job: dict, agg: dict | None) -> None:
+        """Fold one finished job's stage timings into the cumulative
+        counters, the registered /metrics registries, and the caller's
+        span-attr aggregate.  Runs on the awaiting event-loop thread."""
+        stages = {
+            "queue_wait": (job.get("prep_wait_s", 0.0)
+                           + job.get("launch_wait_s", 0.0)),
+            "host_prep": job.get("host_prep_s"),
+            "device_exec": job.get("device_exec_s"),
+            "fetch": job.get("fetch_s"),
+        }
+        with self._lock:
+            for stage, dt in stages.items():
+                if dt is None:
+                    continue
+                key = (op, stage)
+                self.stage_seconds[key] = (
+                    self.stage_seconds.get(key, 0.0) + dt)
+        for reg in metrics_registries():
+            for stage, dt in stages.items():
+                if dt is not None:
+                    reg.observe("core_dispatch_stage_seconds", dt,
+                                labels={"stage": stage, "op": op})
+        if agg is not None:
+            for stage, dt in stages.items():
+                if dt is not None:
+                    agg[stage + "_s"] = agg.get(stage + "_s", 0.0) + dt
+
+    def stage_stats(self) -> dict:
+        """Snapshot for /debug/memory: cumulative per-(op, stage)
+        seconds, busy totals, queue depth, launch/row counters and the
+        live overlap gauge."""
+        with self._lock:
+            stages = {f"{op}/{stage}": round(dt, 6)
+                      for (op, stage), dt in sorted(self.stage_seconds.items())}
+            snap = {
+                "queue_depth": self.queue_depth,
+                "prep_busy_s": round(self.prep_busy_s, 6),
+                "device_busy_s": round(self.device_busy_s, 6),
+                "launches": self.launches,
+                "verify_rows": self.verify_rows,
+                "stage_seconds": stages,
+            }
+        snap["overlap_efficiency"] = round(self.overlap_efficiency(), 4)
+        return snap
+
+    async def _pipelined(self, stages, payloads, op: str,
+                         stats: dict | None = None) -> list:
         """Run each payload through (prep, exec); prep of payload *i+1*
         overlaps the launch of payload *i*.  Returns per-payload results
         in submission order; the FIRST stage exception is re-raised after
@@ -211,19 +404,29 @@ class DispatchPipeline:
         orphaned executor jobs mutating shared counters mid-test)."""
         prep_fn, exec_fn = stages
         loop = asyncio.get_running_loop()
-        launch_futs = []
+        launch_futs, jobs = [], []
         prep_exc: BaseException | None = None
         for payload in payloads:
+            job = {"t_submit": time.perf_counter()}
             try:
                 prepared = await loop.run_in_executor(
-                    self._prep_pool, self._run_prep, prep_fn, payload)
+                    self._prep_pool, self._run_prep, prep_fn, payload, job)
             except BaseException as exc:  # noqa: BLE001 — re-raised below
                 prep_exc = exc
                 break
             self._bump_depth(+1)
-            launch_futs.append(loop.run_in_executor(
-                self._launch_pool, self._run_launch, exec_fn, prepared))
+            job["t_enq_launch"] = time.perf_counter()
+            fut = loop.run_in_executor(
+                self._launch_pool, self._run_launch, exec_fn, prepared, job)
+            launch_futs.append(asyncio.ensure_future(
+                self._finish(fut, job)))
+            jobs.append(job)
         results = await asyncio.gather(*launch_futs, return_exceptions=True)
+        for job, r in zip(jobs, results):
+            if not isinstance(r, BaseException):
+                self._record_job(op, job, stats)
+        if stats is not None:
+            stats["tiles"] = stats.get("tiles", 0) + len(jobs)
         if prep_exc is not None:
             raise prep_exc
         for r in results:
@@ -238,15 +441,18 @@ class DispatchPipeline:
         (telemetry callers attribute paths/padding per tile)."""
         return tile_sizes(n, self._tile_of())
 
-    async def batch_verify(self, entries) -> list:
+    async def batch_verify(self, entries, stats: dict | None = None) -> list:
         """`tbls.batch_verify` off-loop, tiled into pipelined
-        sub-launches when the batch exceeds the tile size."""
+        sub-launches when the batch exceeds the tile size.  When a
+        `stats` dict is passed, per-stage seconds (summed over tiles)
+        are aggregated into it for span attribution."""
         from . import api
 
         n = len(entries)
         if n == 0:
             return []
-        self.verify_rows += n
+        with self._lock:
+            self.verify_rows += n
         # tile_sizes never returns an empty plan (tile ≤ 0 → one
         # whole-batch launch): an empty plan would resolve every awaiter
         # with zero verdicts and fail OPEN at `all([])` call-sites
@@ -254,10 +460,12 @@ class DispatchPipeline:
         for size in self.plan_verify(n):
             payloads.append(entries[pos:pos + size])
             pos += size
-        per_tile = await self._pipelined(api.verify_stages(), payloads)
+        per_tile = await self._pipelined(api.verify_stages(), payloads,
+                                         op="verify", stats=stats)
         return [ok for part in per_tile for ok in part]
 
-    async def threshold_combine(self, batch) -> list:
+    async def threshold_combine(self, batch,
+                                stats: dict | None = None) -> list:
         """`tbls.threshold_combine` off-loop: host packing (Lagrange
         digit lookups, byte shuffling) on the prep thread, the MSM
         launch on the launch thread."""
@@ -265,7 +473,8 @@ class DispatchPipeline:
 
         if not batch:
             return []
-        [out] = await self._pipelined(api.combine_stages(), [batch])
+        [out] = await self._pipelined(api.combine_stages(), [batch],
+                                      op="combine", stats=stats)
         return out
 
     async def prewarm(self, pubshares, num_validators: int,
@@ -315,4 +524,11 @@ def default_pipeline() -> DispatchPipeline | None:
         return None
     if _default is None:
         _default = DispatchPipeline()
+    return _default
+
+
+def current_pipeline() -> DispatchPipeline | None:
+    """The process-wide pipeline IF it already exists — never creates
+    one (telemetry/debug readers must not spin up executor threads as a
+    side effect of a /metrics scrape)."""
     return _default

@@ -1,0 +1,226 @@
+"""SLO-triggered auto-profiler: rate limit + process-global guard + ring
+bound (fake clock, stub captures), a real jax.profiler capture, the
+loop-lag breach detector, and the acceptance path — an induced
+slot-budget breach on a crypto-free simnet node produces exactly one
+bounded capture stamped with the duty's trace ID."""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from charon_tpu.app import autoprofile, monitoring
+from charon_tpu.app.monitoring import Registry
+from charon_tpu.app.tracing import duty_trace_id
+from charon_tpu.core.slotbudget import SlotBudget
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.tbls import api as tbls
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _stub_capture(paths):
+    def capture(cap_dir):
+        paths.append(cap_dir)
+        with open(os.path.join(cap_dir, "trace.bin"), "wb") as fh:
+            fh.write(b"x")
+
+    return capture
+
+
+def _caps(out_dir):
+    return sorted(d for d in os.listdir(out_dir) if d.startswith("cap"))
+
+
+def test_rate_limit_exactly_one_capture(tmp_path):
+    """A breach storm captures ONCE per min_interval; advancing the
+    fake clock past the interval re-arms."""
+    clock, paths = FakeClock(), []
+    ap = autoprofile.AutoProfiler(str(tmp_path), ring=8, min_interval=300,
+                                  clock=clock,
+                                  capture_fn=_stub_capture(paths))
+
+    async def storm():
+        got = [await ap.trigger("late_duty") for _ in range(5)]
+        return got
+
+    got = asyncio.run(storm())
+    assert sum(g is not None for g in got) == 1
+    assert ap.captures == 1 and ap.skipped_rate_limited == 4
+    assert len(_caps(tmp_path)) == 1
+    clock.now += 301
+    assert asyncio.run(ap.trigger("late_duty")) is not None
+    assert ap.captures == 2
+
+
+def test_process_global_guard_respected(tmp_path):
+    """A manual /debug/profile in flight (the process-wide jax.profiler
+    guard held) makes the trigger skip, never queue or double-start."""
+    paths = []
+    ap = autoprofile.AutoProfiler(str(tmp_path), min_interval=0,
+                                  clock=FakeClock(),
+                                  capture_fn=_stub_capture(paths))
+    assert monitoring.profile_guard_acquire()
+    try:
+        assert asyncio.run(ap.trigger("loop_lag")) is None
+        assert ap.skipped_guard_busy == 1 and ap.captures == 0
+    finally:
+        monitoring.profile_guard_release()
+    # guard released by the capture itself: back-to-back triggers work
+    assert asyncio.run(ap.trigger("loop_lag")) is not None
+    assert asyncio.run(ap.trigger("loop_lag")) is not None
+    assert not monitoring._PROFILE_ACTIVE
+
+
+def test_ring_bounded_and_meta_stamped(tmp_path):
+    clock, paths = FakeClock(), []
+    reg = Registry()
+    ap = autoprofile.AutoProfiler(str(tmp_path), registry=reg, ring=2,
+                                  min_interval=0, clock=clock,
+                                  capture_fn=_stub_capture(paths))
+
+    async def three():
+        for k in range(3):
+            assert await ap.trigger("late_duty", trace_id=f"{k:032x}",
+                                    detail="sigagg") is not None
+
+    asyncio.run(three())
+    caps = _caps(tmp_path)
+    assert len(caps) == 2, "ring must prune to the newest 2 captures"
+    assert caps == ["cap0002-late_duty", "cap0003-late_duty"]
+    meta = json.loads(
+        (tmp_path / caps[-1] / "meta.json").read_text())
+    assert meta["reason"] == "late_duty"
+    assert meta["trace_id"] == f"{2:032x}"
+    assert meta["detail"] == "sigagg"
+    assert 'app_autoprofile_captures_total{reason="late_duty"} 3.0' \
+        in reg.render()
+
+
+def test_capture_error_counted_never_raised(tmp_path):
+    def boom(cap_dir):
+        raise OSError("disk full")
+
+    ap = autoprofile.AutoProfiler(str(tmp_path), min_interval=0,
+                                  clock=FakeClock(), capture_fn=boom)
+    assert asyncio.run(ap.trigger("late_duty")) is None
+    assert ap.capture_errors == 1
+    assert _caps(tmp_path) == []          # failed capture dir pruned
+    assert not monitoring._PROFILE_ACTIVE  # guard released on failure
+
+
+def test_real_jax_capture_nonempty(tmp_path):
+    """The default capture is a real jax.profiler trace (CPU works like
+    TPU here) — the ring dir must contain actual profiler output next
+    to the meta stamp."""
+    ap = autoprofile.AutoProfiler(str(tmp_path), min_interval=0,
+                                  seconds=0.05)
+    cap = asyncio.run(ap.trigger("loop_lag"))
+    assert cap is not None
+    files = [os.path.join(dp, f)
+             for dp, _, fns in os.walk(cap) for f in fns]
+    assert any("meta.json" in f for f in files)
+    assert len(files) > 1, "capture contains no profiler output"
+
+
+def test_loop_lag_breach_fires_autoprofiler_hook():
+    """p99 over the rolling window above the SLO → on_breach fires (the
+    profiler's own rate limit bounds captures)."""
+    reg = Registry()
+    breaches = []
+
+    async def main():
+        probe = asyncio.ensure_future(monitoring.loop_lag_probe(
+            reg, interval=0.002, lag_slo=0.01,
+            on_breach=breaches.append))
+        try:
+            # accumulate the minimum sample count, then hog the loop
+            await asyncio.sleep(0.1)
+            for _ in range(3):
+                time.sleep(0.03)       # blocking: the loop stalls
+                await asyncio.sleep(0.01)
+            for _ in range(100):
+                if breaches:
+                    return
+                await asyncio.sleep(0.005)
+        finally:
+            probe.cancel()
+
+    asyncio.run(main())
+    assert breaches and breaches[0] == "loop_lag"
+    assert "core_dispatch_overlap_efficiency" not in reg.render()  # no pipe
+
+
+def test_slotbudget_breach_one_bounded_capture(tmp_path, monkeypatch):
+    """ACCEPTANCE: an induced slot-budget breach on a crypto-free simnet
+    node produces exactly ONE bounded auto-profile capture, stamped with
+    the triggering duty's deterministic trace ID and the blamed phase;
+    a second breach inside the rate-limit window captures nothing."""
+    monkeypatch.setenv("CHARON_TPU_AUTOPROFILE", "1")
+    monkeypatch.setenv("CHARON_TPU_AUTOPROFILE_DIR",
+                       str(tmp_path / "ring-{node}"))
+    monkeypatch.setenv("CHARON_TPU_AUTOPROFILE_SECONDS", "0.05")
+    monkeypatch.setenv("CHARON_TPU_LOOP_GUARD", "1")
+    tbls.set_scheme("insecure-test")
+    try:
+        from tests.test_observability_e2e import build_observable_cluster
+
+        cluster, bmock, nodes, sinks = build_observable_cluster(tmp_path)
+        node = nodes[0]
+        assert node.autoprofiler is not None
+        # a duty whose final expected phase (bcast) never happened is
+        # late by the watchdog's never-completed rule — deterministic,
+        # no wall-clock dependence on the 0.25 s budget
+        duty = Duty(slot=0, type=DutyType.ATTESTER)
+
+        async def induce():
+            sb = node.slotbudget
+            await sb.on_duty_scheduled(duty, None)
+            await sb.on_fetched(duty, None)
+            await sb.on_consensus(duty, None)
+            await sb.on_threshold(duty, None, None)
+            await sb.on_aggregated(duty, None, None)
+            before = node.autoprofiler.captures
+            sb.finalize(duty)
+            deadline = time.time() + 10
+            while (node.autoprofiler.captures == before
+                   and time.time() < deadline):
+                await asyncio.sleep(0.02)
+            # second breach inside the rate-limit window: skipped
+            duty2 = Duty(slot=1, type=DutyType.ATTESTER)
+            await sb.on_duty_scheduled(duty2, None)
+            sb.finalize(duty2)
+            await asyncio.sleep(0.2)
+
+        asyncio.run(induce())
+        assert node.autoprofiler.captures == 1
+        assert node.autoprofiler.skipped_rate_limited >= 1
+        ring = tmp_path / "ring-node0"
+        caps = _caps(ring)
+        assert len(caps) == 1, "exactly one bounded capture expected"
+        meta = json.loads((ring / caps[0] / "meta.json").read_text())
+        assert meta["reason"] == "late_duty"
+        assert meta["trace_id"] == duty_trace_id(duty)
+        assert meta["detail"] == "bcast"  # the phase that never happened
+    finally:
+        tbls.set_scheme("bls")
+
+
+def test_from_env_defaults(monkeypatch):
+    monkeypatch.delenv("CHARON_TPU_AUTOPROFILE", raising=False)
+    # auto: caller default decides (App on, test-simnet Node off)
+    assert autoprofile.from_env(default_on=False) is None
+    assert autoprofile.from_env(default_on=True) is not None
+    monkeypatch.setenv("CHARON_TPU_AUTOPROFILE", "0")
+    assert autoprofile.from_env(default_on=True) is None
+    monkeypatch.setenv("CHARON_TPU_AUTOPROFILE", "1")
+    ap = autoprofile.from_env(default_on=False, node_name="n7")
+    assert ap is not None and "n7" in ap.out_dir

@@ -1,0 +1,194 @@
+"""Bench-trend parser + regression gate (charon_tpu/analysis/bench_trend)
+on synthetic BENCH fixtures and the real repo history — pure JSON, no
+TPU/jax needed (the bench.py postflight gate must be trustworthy before
+any TPU session relies on it)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from charon_tpu.analysis import bench_trend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wrapper_round(n, parsed, rc=0):
+    return {"n": n, "cmd": "python bench.py", "rc": rc,
+            "tail": "…", **({"parsed": parsed} if parsed is not None else {})}
+
+
+def _raw_round(verify=2000.0, p50=8000.0, p99=9000.0, overlap=0.8,
+               fd_verify=120.0, fd_combine=900.0):
+    return {
+        "metric": "sigagg_latency_p99_ms", "value": p99, "unit": "ms",
+        "p50_ms": p50, "verify_throughput_sig_s": verify,
+        "dispatch": {"first_duty_verify_ms": fd_verify,
+                     "first_duty_combine_ms": fd_combine},
+        "configs": [
+            {"config": "pipeline-ab-verify-4x2048",
+             "overlap_efficiency": overlap},
+            {"config": "pipeline-ab-verify2048+combine2000",
+             "overlap_efficiency": overlap - 0.1},
+        ],
+    }
+
+
+def _write(tmp_path, n, doc):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(doc))
+
+
+@pytest.fixture
+def history(tmp_path):
+    """Synthetic improving history: wrapper + raw forms, one failed
+    round (rc=1, stays a gap), one pre-metric round."""
+    _write(tmp_path, 1, _wrapper_round(
+        1, {"metric": "sigagg_throughput", "value": 3.5e7}))
+    _write(tmp_path, 2, _wrapper_round(2, None, rc=1))
+    _write(tmp_path, 3, _wrapper_round(
+        3, _raw_round(verify=1000.0, p50=50000.0, p99=60000.0,
+                      overlap=0.5, fd_verify=400.0, fd_combine=4000.0)))
+    _write(tmp_path, 4, _raw_round())     # bench.py raw form, the best
+    return tmp_path
+
+
+def test_parse_both_forms_and_failed_rounds(history):
+    rounds = bench_trend.load_rounds(str(history))
+    assert [r.n for r in rounds] == [1, 2, 3, 4]
+    assert not rounds[1].ok and "rc=1" in rounds[1].note
+    assert rounds[0].ok and rounds[0].values == {}   # pre-metric round
+    assert rounds[3].values["verify_sigs_per_s"] == 2000.0
+    assert rounds[3].values["overlap_efficiency"] == pytest.approx(0.8)
+    assert rounds[3].values["first_duty_combine_ms"] == 900.0
+
+
+def test_trend_best_latest_and_series(history):
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    assert trend["latest"]["round"] == 4
+    assert trend["best"]["verify_sigs_per_s"] == {
+        "round": 4, "value": 2000.0, "platform": None}
+    assert trend["best"]["combine_p50_ms"] == {
+        "round": 4, "value": 8000.0, "platform": None}
+    # series skip rounds without the metric — no zeros, no gaps-as-values
+    assert [pt["round"] for pt in trend["series"]["verify_sigs_per_s"]] \
+        == [3, 4]
+    table = bench_trend.render_table(trend)
+    assert "verify_sigs_per_s" in table and "r04" in table
+
+
+def test_gate_passes_on_improving_history(history):
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    assert bench_trend.check_regression(trend, tolerance=0.10) == []
+
+
+def test_gate_fails_on_regressed_fixture(history):
+    # round 5 halves verify throughput and triples combine p50
+    _write(history, 5, _raw_round(verify=1000.0, p50=24000.0))
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    failures = bench_trend.check_regression(trend, tolerance=0.10)
+    joined = "\n".join(failures)
+    assert "verify_sigs_per_s" in joined and "combine_p50_ms" in joined
+    # higher-is-better and lower-is-better directions both caught
+    assert "below best" in joined and "above best" in joined
+
+
+def test_gate_tolerance_respected(history):
+    # 5% worse on verify: inside the 10% tolerance, outside 2%
+    _write(history, 5, _raw_round(verify=1900.0))
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    assert bench_trend.check_regression(trend, tolerance=0.10) == []
+    failures = bench_trend.check_regression(trend, tolerance=0.02)
+    assert failures and "verify_sigs_per_s" in failures[0]
+
+
+def test_missing_metric_in_latest_warns_not_fails(history):
+    # latest round drops overlap_efficiency + first-duty numbers (e.g. a
+    # configs-disabled run): warned, never silently treated as regressed
+    _write(history, 5, {"metric": "sigagg_latency_p99_ms", "value": 8500.0,
+                        "p50_ms": 7900.0, "verify_throughput_sig_s": 2100.0})
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    assert bench_trend.check_regression(trend, tolerance=0.10) == []
+    missing = bench_trend.untracked_in_latest(trend)
+    assert "overlap_efficiency" in missing
+    assert "first_duty_verify_ms" in missing
+
+
+def test_main_writes_trend_json_and_exit_codes(history, capsys):
+    rc = bench_trend.main(["--dir", str(history), "--check-regression"])
+    assert rc == 0
+    doc = json.loads((history / "BENCH_TREND.json").read_text())
+    assert doc["latest"]["round"] == 4
+    assert capsys.readouterr().out.count("PASS") == 1
+    _write(history, 5, _raw_round(verify=500.0))
+    rc = bench_trend.main(["--dir", str(history), "--check-regression"])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_main_on_real_repo_history(tmp_path, capsys):
+    """Acceptance: the gate PASSES on the repo's actual BENCH_r*.json
+    trajectory (r01 pre-metric, r02/r05 failed rounds, r03→r04
+    improving)."""
+    rc = bench_trend.main(["--dir", REPO, "--check-regression",
+                           "--out", str(tmp_path / "BENCH_TREND.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "regression gate: PASS" in out
+    trend = json.loads((tmp_path / "BENCH_TREND.json").read_text())
+    assert any(pt["round"] == 4
+               for pt in trend["series"]["verify_sigs_per_s"])
+
+
+def test_cli_module_entry(history):
+    """`python -m charon_tpu.analysis.bench_trend` is the operator
+    surface bench.py's postflight shells into — pin its exit codes."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ok = subprocess.run(
+        [sys.executable, "-m", "charon_tpu.analysis.bench_trend",
+         "--dir", str(history), "--check-regression"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    _write(history, 6, _raw_round(verify=100.0))
+    bad = subprocess.run(
+        [sys.executable, "-m", "charon_tpu.analysis.bench_trend",
+         "--dir", str(history), "--check-regression"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert bad.returncode == 1
+    assert "REGRESSION" in bad.stdout
+
+
+def test_gate_compares_like_platforms_only(history):
+    """A CPU dry run must never 'regress' against a TPU best (and vice
+    versa): the gate restricts each metric's best to rounds on the
+    latest round's platform; platform-less legacy rounds match any."""
+    for n in (3, 4):
+        doc = json.loads((history / f"BENCH_r{n:02d}.json").read_text())
+        parsed = doc.get("parsed", doc)
+        parsed["platform"] = "tpu"
+        _write(history, n, doc)
+    # CPU round, 20× slower than the TPU best: passes (no comparable
+    # CPU history), and the trend records the platform split
+    cpu = _raw_round(verify=100.0, p50=160000.0)
+    cpu["platform"] = "cpu"
+    _write(history, 5, cpu)
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    assert trend["latest"]["platform"] == "cpu"
+    assert bench_trend.check_regression(trend, tolerance=0.10) == []
+    # a SECOND cpu round regressing vs the first cpu round DOES fail,
+    # and the failure names the platform restriction
+    cpu2 = _raw_round(verify=40.0, p50=400000.0)
+    cpu2["platform"] = "cpu"
+    _write(history, 6, cpu2)
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    failures = bench_trend.check_regression(trend, tolerance=0.10)
+    assert failures and "platform=cpu" in failures[0]
+    # back on tpu: the tpu best still gates tpu rounds
+    tpu = _raw_round(verify=500.0)
+    tpu["platform"] = "tpu"
+    _write(history, 7, tpu)
+    trend = bench_trend.build_trend(bench_trend.load_rounds(str(history)))
+    failures = bench_trend.check_regression(trend, tolerance=0.10)
+    assert any("verify_sigs_per_s" in f and "platform=tpu" in f
+               for f in failures)

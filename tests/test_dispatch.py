@@ -523,3 +523,213 @@ def test_inline_dispatch_freezes_loop_baseline(monkeypatch):
     assert asyncio.run(main()) is True
     # the freeze: a single lag sample swallowed the whole launch
     assert max(lags) >= 0.5, "inline launch should have frozen the loop"
+
+
+# ---------------------------------------------------------------------------
+# Per-stage attribution + overlap gauge (round 13)
+# ---------------------------------------------------------------------------
+
+def _registry():
+    from charon_tpu.app.monitoring import Registry
+
+    return Registry(const_labels={"node": "t"})
+
+
+def test_stage_attribution_histograms_and_stats():
+    """Every pipeline job decomposes into queue_wait / host_prep /
+    device_exec / fetch: the per-(stage, op) histograms land on every
+    registered registry, the cumulative stage_seconds snapshot matches,
+    and the caller's stats dict carries the same sums for span attrs."""
+    reg = _registry()
+    dispatch.add_metrics_registry(reg)
+    pipe = dispatch.DispatchPipeline()
+    sk, pk = _keypair(b"\x31")
+    entries = [(pk, b"m%d" % k, tbls.sign(sk, b"m%d" % k))
+               for k in range(4)]
+    try:
+        vstats: dict = {}
+        cstats: dict = {}
+
+        async def run():
+            oks = await pipe.batch_verify(entries, stats=vstats)
+            out = await pipe.threshold_combine(
+                [{1: b"\x00" * 96, 2: b"\x01" * 96}], stats=cstats)
+            return oks, out
+
+        oks, out = asyncio.run(run())
+        assert oks == [True] * 4 and len(out) == 1
+    finally:
+        dispatch.remove_metrics_registry(reg)
+        pipe.shutdown()
+
+    for op, stats in (("verify", vstats), ("combine", cstats)):
+        assert stats["tiles"] == 1
+        for stage in dispatch.STAGES:
+            assert stats[stage + "_s"] >= 0.0, (op, stage)
+            assert (op, stage) in pipe.stage_seconds, (op, stage)
+    text = reg.render()
+    assert "# TYPE core_dispatch_stage_seconds histogram" in text
+    for stage in dispatch.STAGES:
+        for op in ("verify", "combine"):
+            assert (f'core_dispatch_stage_seconds_count{{node="t",'
+                    f'op="{op}",stage="{stage}"}} 1' in text), (op, stage)
+    # snapshot for /debug/memory mirrors the histograms
+    snap = pipe.stage_stats()
+    assert snap["launches"] == 2 and snap["verify_rows"] == 4
+    assert "verify/device_exec" in snap["stage_seconds"]
+    assert 0.0 <= snap["overlap_efficiency"] <= 1.0
+
+
+def test_stage_attribution_per_tile():
+    """A tiled flush records one histogram sample per sub-launch and the
+    stats dict sums over tiles."""
+    reg = _registry()
+    dispatch.add_metrics_registry(reg)
+    pipe = dispatch.DispatchPipeline(tile=2)
+    sk, pk = _keypair(b"\x32")
+    entries = [(pk, b"m%d" % k, tbls.sign(sk, b"m%d" % k))
+               for k in range(5)]  # tiles: 2+2+1
+    try:
+        stats: dict = {}
+        assert asyncio.run(pipe.batch_verify(entries, stats=stats)) \
+            == [True] * 5
+    finally:
+        dispatch.remove_metrics_registry(reg)
+        pipe.shutdown()
+    assert stats["tiles"] == 3
+    assert ('core_dispatch_stage_seconds_count{node="t",op="verify",'
+            'stage="device_exec"} 3' in reg.render())
+
+
+def test_overlap_efficiency_rolling_window():
+    """Idle pipeline → 0; after real launch work inside the window the
+    gauge reports the launch-thread busy fraction (≤ 1)."""
+    pipe = dispatch.DispatchPipeline(window=2.0)
+    assert pipe.overlap_efficiency() == 0.0
+    orig = tbls.batch_verify
+
+    def busy(entries):
+        time.sleep(0.05)
+        return orig(entries)
+
+    try:
+        tbls_stages = tbls.verify_stages
+        sk, pk = _keypair(b"\x33")
+        entries = [(pk, b"m", tbls.sign(sk, b"m"))]
+
+        async def run():
+            import unittest.mock as mock
+
+            with mock.patch.object(tbls, "batch_verify", busy):
+                for _ in range(4):
+                    await pipe.batch_verify(entries)
+
+        asyncio.run(run())
+        eff = pipe.overlap_efficiency()
+        # 4 × 50 ms busy inside a 2 s window ≈ 0.1
+        assert 0.05 <= eff <= 1.0
+        assert tbls.verify_stages is tbls_stages
+    finally:
+        pipe.shutdown()
+
+
+def test_span_and_counters_carry_stage_attribution():
+    """The tpu/batch_verify span grows the per-stage attrs and the
+    verifier records rows-per-second per verify_path."""
+    from charon_tpu.app.tracing import Tracer
+
+    tracer = Tracer()
+    pipe = dispatch.DispatchPipeline()
+    v = BatchVerifier(tracer=tracer, dispatcher=pipe)
+    sk, pk = _keypair(b"\x34")
+    try:
+        ok = asyncio.run(v.verify(pk, b"m", tbls.sign(sk, b"m")))
+        assert ok is True
+    finally:
+        pipe.shutdown()
+    [span] = [s for s in tracer.spans if s.name == "tpu/batch_verify"]
+    for stage in dispatch.STAGES:
+        assert stage + "_s" in span.attrs, stage
+    assert span.attrs["tiles"] == 1
+    assert v.rows_per_s_by_path == {"insecure-test": pytest.approx(
+        v.rows_per_s_by_path["insecure-test"])}
+    assert v.rows_per_s_by_path["insecure-test"] > 0
+
+
+def test_combine_span_carries_stage_attribution():
+    from charon_tpu.app.tracing import Tracer
+    from charon_tpu.core.sigagg import SigAgg
+    from charon_tpu.core.types import ParSignedData, SignedRandao
+
+    tracer = Tracer()
+    pipe = dispatch.DispatchPipeline()
+    agg = SigAgg(threshold=2, tracer=tracer, dispatcher=pipe)
+    sk, pk = _keypair(b"\x35")
+    duty = Duty(slot=1, type=DutyType.RANDAO)
+    parsigs = [ParSignedData(
+        data=SignedRandao(epoch=0, signature=(i).to_bytes(96, "big")),
+        share_idx=i) for i in (1, 2)]
+    try:
+        asyncio.run(agg.aggregate(duty, pk, parsigs))
+    finally:
+        pipe.shutdown()
+    [span] = [s for s in tracer.spans
+              if s.name == "tpu/threshold_combine"]
+    for stage in dispatch.STAGES:
+        assert stage + "_s" in span.attrs, stage
+
+
+def test_concurrent_scrape_lock_discipline():
+    """SATELLITE PIN: the rolling busy window, stage accumulators and
+    queue depth are mutated by the prep/launch threads while scrape
+    threads snapshot them.  Unlocked, the deque trimmed mid-``sum()``
+    raises RuntimeError and `+=` races lose launches; under the shared
+    lock, three hammering scrape threads observe exception-free,
+    consistent state and the final counters reconcile exactly."""
+    import threading
+
+    reg = _registry()
+    dispatch.add_metrics_registry(reg)
+    pipe = dispatch.DispatchPipeline(window=0.05)  # constant trimming
+    sk, pk = _keypair(b"\x36")
+    entries = [(pk, b"m", tbls.sign(sk, b"m"))]
+    stop = threading.Event()
+    scrape_errors: list = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                eff = pipe.overlap_efficiency()
+                assert 0.0 <= eff <= 1.0
+                snap = pipe.stage_stats()
+                assert snap["queue_depth"] >= 0
+                assert snap["launches"] >= 0
+                reg.render()
+            except Exception as exc:  # noqa: BLE001 — the pin
+                scrape_errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in threads:
+        t.start()
+    N = 150
+
+    async def hammer():
+        for _ in range(N):
+            await pipe.batch_verify(entries)
+
+    try:
+        asyncio.run(hammer())
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        dispatch.remove_metrics_registry(reg)
+        pipe.shutdown()
+    assert not scrape_errors, scrape_errors
+    assert pipe.launches == N
+    assert pipe.queue_depth == 0
+    assert pipe.verify_rows == N
+    text = reg.render()
+    assert (f'core_dispatch_stage_seconds_count{{node="t",op="verify",'
+            f'stage="device_exec"}} {N}' in text)

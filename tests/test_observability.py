@@ -447,6 +447,23 @@ def test_monitoring_content_types_and_debug_endpoints():
             assert mem["live_arrays"] >= 0
             assert mem["tracer"]["spans_buffered"] == 1
             assert mem["extra_stat"] == 42
+            # round-13 satellite: the dispatch executor section (queue
+            # depth, prewarm report, per-stage seconds, overlap) serves
+            # whenever the process pipeline exists
+            from charon_tpu.tbls import dispatch as tdispatch
+
+            if tdispatch.current_pipeline() is not None:
+                d = mem["dispatch"]
+                assert d["queue_depth"] >= 0
+                assert "prewarmed" in d
+                assert "stage_seconds" in d
+                assert 0.0 <= d["overlap_efficiency"] <= 1.0
+            # per-graph-key compile counts ride the backend section when
+            # the TPU backend module is loaded in this process
+            import sys as _sys
+
+            if _sys.modules.get("charon_tpu.tbls.backend_tpu"):
+                assert isinstance(mem["compile_programs"], dict)
 
             status, headers, _ = await _fetch(api.port, "/nope")
             assert status.startswith("404")
@@ -614,3 +631,125 @@ def test_tpu_backend_padded_rows_and_paths():
     assert be.combine_padded_rows(3, 2) in (4, 1024)
     assert backend_tpu.combine_path() in ("straus", "dblsel", "jnp")
     assert backend_tpu.pairing_path(2048) in ("pallas-rlc", "jnp")
+
+
+# ---------------------------------------------------------------------------
+# Hot-path performance exports (round 13)
+# ---------------------------------------------------------------------------
+
+def test_export_dispatch_metrics_compile_gauges():
+    """The scrape-time exporter serves the per-program compile gauges —
+    the `all` roll-up is ALWAYS present (0 on a node that never
+    compiled), and once the backend module is loaded its programs get
+    their own series."""
+    from charon_tpu.app.monitoring import export_dispatch_metrics
+
+    reg = Registry(const_labels={"node": "t"})
+    export_dispatch_metrics(reg)
+    text = reg.render()
+    assert re.search(r'app_xla_compiles_total\{node="t",program="all"\} '
+                     r'[0-9]', text)
+    assert_prometheus_valid(text)
+
+    import sys as _sys
+
+    be = _sys.modules.get("charon_tpu.tbls.backend_tpu")
+    if be is not None:
+        be._note_compile("unit_test_program", 1.25, observe=False)
+        export_dispatch_metrics(reg)
+        text = reg.render()
+        assert ('app_xla_compiles_total{node="t",'
+                'program="unit_test_program"} 1' in text)
+        assert ('app_xla_compile_total_seconds{node="t",'
+                'program="unit_test_program"} 1.25' in text)
+        st = be.compile_stats()["unit_test_program"]
+        assert st["count"] == 1 and st["first_s"] == 1.25
+
+
+def test_devcache_hit_ratio_rolling():
+    """charon_tpu_devcache_hit_ratio is the BETWEEN-SCRAPES delta ratio
+    (falling back to the cumulative ratio on an idle window)."""
+    pytest.importorskip("jax")
+    from charon_tpu.app.monitoring import export_devcache_metrics
+    from charon_tpu.tbls import backend_tpu
+
+    cls = backend_tpu.TPUBackend
+    reg = Registry()
+    saved = (cls.hm_cache_hits, cls.hm_cache_misses)
+    try:
+        cls.hm_cache_hits, cls.hm_cache_misses = 80, 20
+        export_devcache_metrics(reg)
+        key = reg._key("charon_tpu_devcache_hit_ratio", {"cache": "hm"})
+        first = reg._gauges[key]
+        assert first == pytest.approx(0.8)        # cumulative on scrape 1
+        cls.hm_cache_hits += 10                    # 10 hits, 0 misses
+        export_devcache_metrics(reg)
+        assert reg._gauges[key] == pytest.approx(1.0)   # pure delta
+        export_devcache_metrics(reg)               # idle window
+        assert reg._gauges[key] == pytest.approx(90 / 110)  # cumulative
+    finally:
+        cls.hm_cache_hits, cls.hm_cache_misses = saved
+
+
+def test_hbm_live_bytes_sample():
+    """One sample sets the gauge (live-array fallback on CPU) and the
+    loop serves it immediately at task start."""
+    pytest.importorskip("jax")
+    from charon_tpu.app.monitoring import (hbm_sample_loop,
+                                           sample_hbm_live_bytes)
+
+    reg = Registry()
+    n = sample_hbm_live_bytes(reg)
+    assert n >= 0
+    assert reg._gauges[reg._key("charon_tpu_hbm_live_bytes", None)] == n
+
+    reg2 = Registry()
+
+    async def main():
+        task = asyncio.ensure_future(hbm_sample_loop(reg2, interval=30.0))
+        try:
+            for _ in range(200):
+                if reg2._gauges:
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            task.cancel()
+
+    asyncio.run(main())
+    assert reg2._gauges.get(
+        reg2._key("charon_tpu_hbm_live_bytes", None)) is not None
+
+
+def test_registry_thread_safe_under_concurrent_writers():
+    """Registry writes from several threads while another renders: no
+    lost increments, no RuntimeError from dict growth mid-render (the
+    compile timers write from the launch thread since round 13)."""
+    import threading
+
+    reg = Registry()
+    N, T = 500, 4
+    render_errors = []
+
+    def writer(t):
+        for k in range(N):
+            reg.inc("app_rt_total")
+            reg.observe("app_rt_seconds", 0.001 * k,
+                        labels={"w": str(t)})
+
+    def renderer():
+        for _ in range(50):
+            try:
+                assert_prometheus_valid(reg.render())
+            except Exception as exc:  # noqa: BLE001
+                render_errors.append(exc)
+                return
+
+    threads = ([threading.Thread(target=writer, args=(t,))
+                for t in range(T)]
+               + [threading.Thread(target=renderer)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not render_errors, render_errors
+    assert reg._counters[reg._key("app_rt_total", None)] == N * T

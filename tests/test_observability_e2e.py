@@ -194,6 +194,23 @@ def test_observability_e2e_4_nodes(tmp_path):
                 assert 'phase="consensus"' in text
                 assert 'phase="parsig_ex"' in text
                 assert "core_slot_budget_remaining_seconds" in text
+                # hot-path performance layer (round 13): per-stage
+                # dispatch attribution histograms with stage+op labels,
+                # the live overlap gauge from the loop-lag probe, and
+                # the compile/HBM gauges — served by EVERY node in
+                # valid 0.0.4 even on the crypto-free simnet
+                assert "core_dispatch_stage_seconds_bucket{" in text
+                for stage in ("queue_wait", "host_prep", "device_exec",
+                              "fetch"):
+                    assert f'stage="{stage}"' in text, stage
+                assert 'op="verify"' in text
+                assert 'op="combine"' in text
+                assert "core_dispatch_overlap_efficiency" in text
+                assert re.search(
+                    r'app_xla_compiles_total\{node="node\d+",'
+                    r'program="all"\} ', text)
+                assert re.search(r"charon_tpu_hbm_live_bytes"
+                                 r'\{node="node\d+"\} [0-9]', text)
 
             # --- inclusion delay measured inside the duty window ---
             n0 = nodes[0]

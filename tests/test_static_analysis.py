@@ -173,11 +173,66 @@ reg.inc("app_j_total", labels={"free_text": f"unguarded {x} is fine"})
     assert lint_sources({"charon_tpu/fake.py": good}).ok
 
 
+def test_catalogue_drift_pass():
+    """Every exported family must appear in the docs/observability.md
+    catalogue AND vice versa; histogram `_bucket`/`_sum`/`_count`
+    references in alert exprs normalise to their stem; literal exporter
+    call sites inside EXCLUDE_FILES (app/monitoring.py's scrape-time
+    exporters) count as exported."""
+    from charon_tpu.analysis.metrics_lint import lint_sources
+
+    code = 'reg.observe("app_lat_seconds", 0.1)\n' \
+           'reg.inc("app_undoc_total")\n'
+    excluded = 'reg.set_gauge("app_exporter_gauge", 1.0)\n'
+    doc = ("| `app_lat_seconds` | histogram | x |\n"
+           "| `app_exporter_gauge` | gauge | x |\n"
+           "| `app_ghost_total` | counter | stale |\n"
+           "rate(app_lat_seconds_bucket[5m])\n")
+    report = lint_sources({"charon_tpu/fake.py": code,
+                           "charon_tpu/app/monitoring.py": excluded},
+                          catalogue_doc=doc)
+    text = "\n".join(report.violations)
+    assert "'app_undoc_total' is missing from the" in text
+    assert "'app_ghost_total' which no code exports" in text
+    # the excluded-file exporter gauge and the _bucket reference are
+    # NOT drift
+    assert "app_exporter_gauge" not in text
+    assert "app_lat_seconds" not in text
+    assert len(report.violations) == 2
+
+    # doc covering everything (and nothing extra) passes
+    good_doc = doc.replace("| `app_ghost_total` | counter | stale |\n",
+                           "") + "`app_undoc_total`\n"
+    assert lint_sources({"charon_tpu/fake.py": code,
+                         "charon_tpu/app/monitoring.py": excluded},
+                        catalogue_doc=good_doc).ok
+
+
+def test_catalogue_covers_head_families():
+    """The real doc catalogues the hot-path performance families this
+    round added (lint_package already enforces the full closure — this
+    pins that the closure INCLUDES the new layer)."""
+    from charon_tpu.analysis.metrics_lint import lint_package
+
+    report = lint_package()
+    assert report.ok, "\n".join(report.violations)
+    exported = report.exported_names()
+    for name in ("core_dispatch_stage_seconds",
+                 "core_dispatch_overlap_efficiency",
+                 "app_xla_compile_seconds", "app_xla_compiles_total",
+                 "charon_tpu_devcache_hit_ratio",
+                 "charon_tpu_hbm_live_bytes",
+                 "app_autoprofile_captures_total",
+                 "core_verify_rows_per_s"):
+        assert name in exported, name
+
+
 def test_golden_bad_lint_fixtures_flagged():
     from charon_tpu.analysis.fixtures import audit_golden_bad
 
     for which, needle in (("bad_buckets", "strictly increasing"),
-                          ("unbounded_label", "guarded labels")):
+                          ("unbounded_label", "guarded labels"),
+                          ("undocumented_metric", "missing from the")):
         report = audit_golden_bad(which)
         assert not report.ok
         assert needle in "\n".join(report.violations)
@@ -186,14 +241,15 @@ def test_golden_bad_lint_fixtures_flagged():
 
 def test_cli_golden_bad_lint_exits_nonzero():
     """The lint golden-bads ride the same CLI contract as the kernel
-    fixtures: `--golden-bad unbounded_label` exits 1 (and is cheap — no
-    kernel tracing)."""
-    proc = subprocess.run(
-        [sys.executable, "-m", "charon_tpu.analysis",
-         "--golden-bad", "unbounded_label"],
-        capture_output=True, text=True, timeout=120)
-    assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert "FAIL" in proc.stdout
+    fixtures: `--golden-bad unbounded_label` / `undocumented_metric`
+    exit 1 (and are cheap — no kernel tracing)."""
+    for which in ("unbounded_label", "undocumented_metric"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "charon_tpu.analysis",
+             "--golden-bad", which],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "FAIL" in proc.stdout
 
 
 def test_metric_name_lint_cli_flag():

@@ -338,7 +338,7 @@ def test_verifier_packs_across_inflight_launch():
             def plan_verify(self, n):
                 return [n]
 
-            async def batch_verify(self, entries):
+            async def batch_verify(self, entries, stats=None):
                 launches.append(len(entries))
                 if len(launches) == 1:
                     await self.release.wait()
