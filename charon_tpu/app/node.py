@@ -10,6 +10,7 @@ parsigex) are injected so tests run in-memory clusters
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 
 from ..core import interfaces
@@ -48,9 +49,23 @@ class Node:
 
     def __init__(self, cfg: NodeConfig, eth2cl, consensus, parsigex,
                  slots_per_epoch: int = 16, genesis_time: float = 0.0,
-                 slot_duration: float = 1.0, registry=None, tracer=None):
+                 slot_duration: float = 1.0, registry=None, tracer=None,
+                 clock=None, dutydb=None, aggsigdb=None, probes: bool = True,
+                 fetched_types=None):
+        """`clock` (default wall time) threads one injectable timebase
+        through scheduler, deadliner, tracker, slot budget and
+        broadcaster — the chaos simnet's determinism hook.  `dutydb` /
+        `aggsigdb` accept pre-existing stores so a restarted node re-wires
+        the previous incarnation's state (testutil/chaos.py node-restart
+        faults).  `probes=False` skips the loop-lag/HBM sampling
+        background tasks (virtual-time soak runs don't want wall-clocked
+        samplers).  `fetched_types` narrows the scheduler's triggered duty
+        families."""
         self.cfg = cfg
         self.eth2cl = eth2cl
+        clock = clock if clock is not None else time.time
+        self._clock = clock
+        self._probes = probes
         # Observability rides the in-memory simnet node exactly like the
         # full App: every node gets a Tracer (deterministic duty trace
         # IDs join across nodes), and passing a monitoring Registry also
@@ -60,11 +75,15 @@ class Node:
         self.tracer = tracer if tracer is not None else Tracer(registry)
 
         pubshares = cfg.pubshares_by_peer[cfg.share_idx]
+        sched_kwargs = {}
+        if fetched_types is not None:
+            sched_kwargs["fetched_types"] = tuple(fetched_types)
         self.scheduler = Scheduler(eth2cl, list(pubshares),
-                                   builder_api=cfg.builder_api)
+                                   builder_api=cfg.builder_api,
+                                   clock=clock, **sched_kwargs)
         self.fetcher = Fetcher(eth2cl)
         self.consensus = consensus
-        self.dutydb = MemDutyDB()
+        self.dutydb = dutydb if dutydb is not None else MemDutyDB()
         # Off-loop dispatch pipeline shared by verify + combine launches
         # (None when CHARON_TPU_DISPATCH=0 pins legacy inline launches).
         self.dispatcher = dispatch.default_pipeline()
@@ -89,9 +108,9 @@ class Node:
             parsigex._verify_fn = self._verify_external
         self.sigagg = SigAgg(cfg.threshold, tracer=self.tracer,
                              dispatcher=self.dispatcher)
-        self.aggsigdb = MemAggSigDB()
+        self.aggsigdb = aggsigdb if aggsigdb is not None else MemAggSigDB()
         self.bcast = Broadcaster(eth2cl, genesis_time, slot_duration,
-                                 registry=registry)
+                                 registry=registry, clock=clock)
         self.recaster = Recaster()
         self._spe = slots_per_epoch
         self._genesis_time = genesis_time
@@ -121,7 +140,7 @@ class Node:
                 registry=registry,
                 slot_start_fn=lambda slot: (genesis_time
                                             + slot * slot_duration),
-                budget_seconds=slot_duration)
+                budget_seconds=slot_duration, clock=clock)
             self.scheduler.subscribe_duties(self.slotbudget.on_duty_scheduled)
             self.fetcher.subscribe(self.slotbudget.on_fetched)
             if hasattr(consensus, "subscribe"):
@@ -151,7 +170,8 @@ class Node:
                 num_peers=len(cfg.pubshares_by_peer),
                 threshold=cfg.threshold, registry=registry,
                 slot_start_fn=lambda slot: (genesis_time
-                                            + slot * slot_duration))
+                                            + slot * slot_duration),
+                clock=clock)
             self.scheduler.subscribe_duties(self.tracker.on_duty_scheduled)
             self.fetcher.subscribe(self.tracker.on_fetched)
             if hasattr(consensus, "subscribe"):
@@ -215,7 +235,7 @@ class Node:
         # fresh never-run loop when that ever stops being true
         loop = asyncio.get_running_loop()
         self._run_task = loop.create_task(self.scheduler.run())
-        if self.registry is not None:
+        if self.registry is not None and self._probes:
             # event-loop health: the simnet node exports the same
             # app_event_loop_lag_seconds / dispatch queue-depth /
             # overlap-efficiency families as the full App, so
@@ -235,7 +255,8 @@ class Node:
         if self.tracker is not None:
             self.deadliner = Deadliner(
                 lambda d: duty_deadline(d, self._genesis_time,
-                                        self._slot_duration))
+                                        self._slot_duration),
+                clock=self._clock)
             self.deadliner.start()
             self._gc_task = loop.create_task(self._gc_loop())
 

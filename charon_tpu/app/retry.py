@@ -18,12 +18,17 @@ from ..core.types import Duty
 
 
 def backoff_delays(base: float = 0.1, factor: float = 1.6,
-                   jitter: float = 0.2, max_delay: float = 5.0):
+                   jitter: float = 0.2, max_delay: float = 5.0, rng=None):
     """Infinite generator of jittered exponential delays
-    (reference: expbackoff.go defaults)."""
+    (reference: expbackoff.go defaults).  `rng` takes any object with a
+    `uniform(a, b)` method (e.g. a seeded ``random.Random``) so callers
+    that need bit-identical replay — the chaos simnet, the TCP mesh's
+    reconnect gate — can pin the jitter; default stays the process
+    global RNG."""
     delay = base
+    u = (rng or random).uniform
     while True:
-        yield delay * (1 + random.uniform(-jitter, jitter))
+        yield delay * (1 + u(-jitter, jitter))
         delay = min(delay * factor, max_delay)
 
 

@@ -16,10 +16,11 @@ from .types import (Duty, DutyType, PubKey, SignedData, SignedDataSet,
 
 class Broadcaster:
     def __init__(self, eth2cl, genesis_time: float, slot_duration: float,
-                 registry=None):
+                 registry=None, clock=time.time):
         self._eth2cl = eth2cl
         self._genesis = genesis_time
         self._slot_duration = slot_duration
+        self._clock = clock
         self._registry = registry  # app.monitoring.Registry (optional)
         self.broadcast_delays: list[tuple[Duty, float]] = []  # metric feed
         self._subs: list = []
@@ -57,7 +58,8 @@ class Broadcaster:
             return
         else:
             raise ValueError(f"unsupported duty type {t}")
-        delay = time.time() - (self._genesis + duty.slot * self._slot_duration)
+        delay = self._clock() - (self._genesis
+                                 + duty.slot * self._slot_duration)
         self.broadcast_delays.append((duty, delay))
         if self._registry is not None:
             self._registry.observe("core_bcast_delay_seconds", delay,

@@ -83,7 +83,8 @@ class QBFTConsensus:
     def __init__(self, transport: ConsensusMemNetwork, peer_idx: int,
                  nodes: int, round_timeout_base: float = 0.75,
                  round_timeout_inc: float = 0.25, sniffer=None,
-                 registry=None, tracer=None, trace_id_fn=None):
+                 registry=None, tracer=None, trace_id_fn=None,
+                 clock=time.monotonic):
         self._net = transport
         self._peer_idx = peer_idx
         self._nodes = nodes
@@ -93,7 +94,13 @@ class QBFTConsensus:
         self._registry = registry  # app.monitoring.Registry (optional)
         self._tracer = tracer      # app.tracing.Tracer (optional)
         self._trace_id_fn = trace_id_fn  # app.tracing.duty_trace_id
+        self._clock = clock  # telemetry timebase (injectable for simnets)
         self._subs: list = []
+        # Late-bindable per-duty input values: instances always read their
+        # input through a holder lookup, so a local propose() landing
+        # AFTER an inbound message created the instance still supplies the
+        # value at the next proposal point (see qbft.run docstring).
+        self._inputs: dict[Duty, Any] = {}
         self._prio_subs: list = []
         self._queues: dict[Duty, asyncio.Queue] = {}
         self._tasks: dict[Duty, asyncio.Task] = {}
@@ -162,12 +169,12 @@ class QBFTConsensus:
             on_rule=on_rule,
         )
 
-    def _ensure_instance(self, duty: Duty, input_value: Any) -> None:
+    def _ensure_instance(self, duty: Duty) -> None:
         if duty in self._tasks:
             return
         q = self._queue(duty)
 
-        now = time.monotonic()
+        now = self._clock()
         state = _InstanceState(round=1, round_start=now, started=now)
         if self._tracer is not None:
             trace_id = (self._trace_id_fn(duty)
@@ -184,7 +191,7 @@ class QBFTConsensus:
         t = qbft.Transport(bcast, q)
         task = asyncio.get_event_loop().create_task(
             qbft.run(self._definition(duty), t, duty, self._peer_idx,
-                     input_value))
+                     lambda: self._inputs.get(duty)))
 
         def _log_done(tk: asyncio.Task) -> None:
             if not tk.cancelled() and tk.exception() is not None:
@@ -230,7 +237,7 @@ class QBFTConsensus:
         state = self._states.get(duty)
         if state is None or state.decided:
             return
-        now = time.monotonic()
+        now = self._clock()
         dlabel = {"duty": duty.type.name.lower()}
         new_round = round_
         if msg is not None and rule in self._JUMP_RULES:
@@ -276,13 +283,24 @@ class QBFTConsensus:
     # -- interface ----------------------------------------------------------
 
     async def propose(self, duty: Duty, unsigned: UnsignedDataSet) -> None:
-        """Start (or join) this duty's consensus with our proposed value."""
-        self._ensure_instance(duty, to_value(unsigned))
+        """Start (or join) this duty's consensus with our proposed value.
+        If an inbound message already created the instance, the value is
+        late-bound: the running instance picks it up at its next proposal
+        point (first write wins).  Proposals for GC'd duties are dropped
+        like inbound stragglers (a retried propose landing post-deadline
+        must not resurrect an instance that can never be trimmed again)."""
+        if duty in self._trimmed:
+            return
+        self._inputs.setdefault(duty, to_value(unsigned))
+        self._ensure_instance(duty)
 
     async def propose_priority(self, duty: Duty, value: Any) -> None:
         """Propose a raw hashable value (priority-protocol results) for an
         INFO_SYNC duty."""
-        self._ensure_instance(duty, value)
+        if duty in self._trimmed:
+            return
+        self._inputs.setdefault(duty, value)
+        self._ensure_instance(duty)
 
     async def _deliver(self, duty: Duty, msg: qbft.Msg) -> None:
         # Stragglers for GC'd duties are dropped, not re-buffered.
@@ -290,11 +308,14 @@ class QBFTConsensus:
             return
         await self._queue(duty).put(msg)
         if duty not in self._tasks:
-            # First contact for this duty came from a peer: start a
-            # non-leading instance (input None) so this node still follows
-            # the cluster's decision even if its own fetch failed/lags.
-            # A later local propose() is a no-op for this duty.
-            self._ensure_instance(duty, None)
+            # First contact for this duty came from a peer: start an
+            # instance with no input yet so this node still follows the
+            # cluster's decision even if its own fetch failed/lags.  A
+            # later local propose() late-binds the value through the
+            # holder (an early inbound frame must not permanently null
+            # this node's input — that stalled whole duties when every
+            # honest node saw a byzantine frame first).
+            self._ensure_instance(duty)
 
     def trim(self, duty: Duty) -> None:
         """Deadliner GC (reference: component.go:376-408 deadline sweep)."""
@@ -302,12 +323,13 @@ class QBFTConsensus:
         if task is not None:
             task.cancel()
         self._queues.pop(duty, None)
+        self._inputs.pop(duty, None)
         self._decided.discard(duty)
         state = self._states.pop(duty, None)
         if state is not None:
             # an undecided instance reaching GC is a stuck consensus:
             # close its span so the timeline shows WHERE the slot died
-            self._finish_span(state, time.monotonic())
+            self._finish_span(state, self._clock())
         self._trimmed[duty] = None
         while len(self._trimmed) > 4096:  # bounded straggler-drop memory
             self._trimmed.popitem(last=False)

@@ -34,7 +34,14 @@ class Deadliner:
     """Async deadline manager: `add(duty)`, then iterate `expired()`.
 
     Single internal task orders deadlines in a heap; duplicate adds are
-    deduped (reference: core/deadline.go:37-123 semantics)."""
+    deduped (reference: core/deadline.go:37-123 semantics).
+
+    The `clock` is fully injectable (default ``time.time``): deadline
+    comparisons never touch wall time directly, and `poke()` forces an
+    immediate re-evaluation, so a fake clock that jumped forward can
+    deterministically drive expiry without waiting out the poll cap —
+    the contract the chaos simnet (testutil/chaos.py) and any fake-clock
+    unit test rely on."""
 
     def __init__(self, deadline_fn: Callable[[Duty], float],
                  clock: Callable[[], float] = time.time):
@@ -65,6 +72,12 @@ class Deadliner:
         heapq.heappush(self._heap, (dl, self._seq, duty))
         self._wake.set()
         return True
+
+    def poke(self) -> None:
+        """Force the run loop to re-read the clock and expire anything
+        due — the deterministic hand-crank for fake-clock tests (a jumped
+        clock otherwise waits out the 1 s poll cap below)."""
+        self._wake.set()
 
     async def expired(self) -> AsyncIterator[Duty]:
         """Async stream of duties whose deadline has passed."""

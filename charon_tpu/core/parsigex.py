@@ -68,10 +68,21 @@ class MemParSigExNetwork:
     def __init__(self) -> None:
         self._nodes: list[MemParSigEx] = []
 
-    def join(self, verify_fn=None, registry=None) -> "MemParSigEx":
-        node = MemParSigEx(self, len(self._nodes), verify_fn,
-                           registry=registry)
-        self._nodes.append(node)
+    def join(self, verify_fn=None, registry=None,
+             idx: int | None = None) -> "MemParSigEx":
+        """Join the mesh.  `idx=None` appends a new member; passing an
+        existing index REPLACES that member's endpoint — the node-restart
+        hook (a restarted node must not leave its dead predecessor in the
+        fanout list double-delivering into stale subscribers)."""
+        if idx is None:
+            idx = len(self._nodes)
+        node = MemParSigEx(self, idx, verify_fn, registry=registry)
+        if idx == len(self._nodes):
+            self._nodes.append(node)
+        elif 0 <= idx < len(self._nodes):
+            self._nodes[idx] = node
+        else:
+            raise ValueError(f"rejoin index {idx} out of range")
         return node
 
     async def _fanout(self, from_idx: int, duty: Duty,

@@ -122,7 +122,19 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
     `input_value=None` means "participate but cannot lead": the process
     votes/commits on others' proposals but skips its own PRE-PREPARE when
     leader with nothing justified (peers round-change past it).  This lets
-    a node whose duty fetch failed still follow the cluster's decision."""
+    a node whose duty fetch failed still follow the cluster's decision.
+
+    `input_value` may also be a CALLABLE, re-resolved at every proposal
+    point (round-1 pre-prepare, quorum-round-change re-propose).  This is
+    the late-binding hook: an instance started by an inbound message —
+    before the local fetch finished — picks up the local value as soon as
+    it exists instead of being permanently input-less (without it, one
+    early byzantine/garbage frame per duty nulled every honest node's
+    input and stalled the duty forever; pinned by the chaos simnet's
+    garbage scenario)."""
+
+    def resolve_input() -> Any:
+        return input_value() if callable(input_value) else input_value
 
     round_ = 1
     prepared_round = 0
@@ -169,8 +181,10 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
                              + d.round_timeout(round_))
 
     # Algorithm 1:11 — leader proposes in round 1.
-    if d.is_leader(instance, round_, process) and input_value is not None:
-        await broadcast(MsgType.PRE_PREPARE, input_value)
+    if d.is_leader(instance, round_, process):
+        value0 = resolve_input()
+        if value0 is not None:
+            await broadcast(MsgType.PRE_PREPARE, value0)
 
     # The timed receive is an explicit getter + asyncio.wait, NOT
     # asyncio.wait_for: wait_for (3.8-3.11) returns the ready result and
@@ -260,7 +274,7 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
                 await broadcast_round_change()
 
             elif rule == UponRule.QUORUM_ROUND_CHANGES:     # Algorithm 3:11
-                value = input_value
+                value = resolve_input()
                 pr_pv = get_single_justified_pr_pv(d, justification)
                 if pr_pv is not None:
                     _, pv = pr_pv

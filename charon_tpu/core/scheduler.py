@@ -43,11 +43,20 @@ _FETCHED_TYPES = (DutyType.ATTESTER, DutyType.AGGREGATOR, DutyType.PROPOSER,
 
 
 class Scheduler:
+    """`clock`/`sleep` are injectable (defaults: ``time.time`` /
+    ``asyncio.sleep``) so fake-clock tests and the chaos simnet drive the
+    slot ticker deterministically; `fetched_types` narrows which duty
+    families the ticker triggers (default: the full production set)."""
+
     def __init__(self, eth2cl, pubkeys: list[PubKey],
-                 builder_api: bool = False):
+                 builder_api: bool = False, clock=time.time, sleep=None,
+                 fetched_types: tuple = _FETCHED_TYPES):
         self._eth2cl = eth2cl
         self._pubkeys = list(pubkeys)
         self._builder_api = builder_api
+        self._clock = clock
+        self._sleep = sleep if sleep is not None else asyncio.sleep
+        self._fetched_types = tuple(fetched_types)
         self._duty_subs: list = []
         self._slot_subs: list = []
         self._defs: dict[Duty, DutyDefinitionSet] = {}
@@ -88,11 +97,11 @@ class Scheduler:
         spe = spec["SLOTS_PER_EPOCH"]
 
         while not self._stop:
-            now = time.time()
+            now = self._clock()
             slot_num = max(0, int((now - genesis) // slot_dur))
             slot_start = genesis + slot_num * slot_dur
-            if slot_start + slot_dur <= time.time():
-                await asyncio.sleep(0)  # missed; recompute (skip, :525-532)
+            if slot_start + slot_dur <= self._clock():
+                await self._sleep(0)  # missed; recompute (skip, :525-532)
                 continue
             tick = SlotTick(slot_num, slot_start, slot_dur, spe)
 
@@ -102,7 +111,7 @@ class Scheduler:
             self._schedule_slot_duties(tick)
 
             next_start = slot_start + slot_dur
-            await asyncio.sleep(max(0.0, next_start - time.time()))
+            await self._sleep(max(0.0, next_start - self._clock()))
 
     def stop(self) -> None:
         self._stop = True
@@ -202,7 +211,7 @@ class Scheduler:
         """Spawn one task per duty of this slot, firing at its offset
         (reference: scheduler.go:173-245)."""
         for duty, defset in list(self._defs.items()):
-            if duty.slot != tick.slot or duty.type not in _FETCHED_TYPES:
+            if duty.slot != tick.slot or duty.type not in self._fetched_types:
                 continue
             offset = DUTY_OFFSETS.get(duty.type, 0.0)
             fire_at = tick.time + offset * tick.slot_duration
@@ -211,7 +220,7 @@ class Scheduler:
 
     async def _fire(self, duty: Duty, defset: DutyDefinitionSet,
                     fire_at: float) -> None:
-        await asyncio.sleep(max(0.0, fire_at - time.time()))
+        await self._sleep(max(0.0, fire_at - self._clock()))
         for fn in self._duty_subs:
             try:
                 await fn(duty, defset)

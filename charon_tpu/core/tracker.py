@@ -98,7 +98,8 @@ class Tracker:
     wiring)."""
 
     def __init__(self, num_peers: int, threshold: int, registry=None,
-                 slot_start_fn=None):
+                 slot_start_fn=None, clock=time.time):
+        self._clock = clock
         self._events: dict[Duty, set[Step]] = defaultdict(set)
         self._parsigs: dict[Duty, dict[PubKey, set[int]]] = defaultdict(
             lambda: defaultdict(set))
@@ -153,7 +154,7 @@ class Tracker:
         # first aggregate of the duty = broadcast hand-off time (the
         # inclusion-delay numerator; reference: incldelay.go:39-117 uses
         # the block-import observation, here the bcast edge)
-        self._bcast_time.setdefault(duty, time.time())
+        self._bcast_time.setdefault(duty, self._clock())
 
     def _record_parsigs(self, duty: Duty, pset: ParSignedDataSet) -> None:
         for pubkey, psig in pset.items():

@@ -136,12 +136,30 @@ def _derive_keys(shared: bytes, cluster_hash: bytes, eph_i: bytes,
 
 
 class TCPMesh:
-    """One node's endpoint in the full mesh."""
+    """One node's endpoint in the full mesh.
+
+    Reconnect policy: a failed dial puts the peer behind a jittered
+    exponential backoff gate (app/retry.backoff_delays, capped at
+    `backoff_ceiling`).  Sends while the gate is closed fail FAST without
+    touching the socket — under a flapping link the dial rate is bounded
+    by the backoff schedule, not by the send rate (the reconnect-storm
+    failure mode), while every fast-failed send still rides the
+    ``app_p2p_send_failure_streak`` gauge so a peer the mesh has
+    effectively given up on is visible at /metrics.  A successful dial
+    resets the gate.  `rng` pins the jitter for deterministic tests.
+
+    `faults` is the chaos-harness injection point (testutil/chaos.py):
+    an object with async hooks ``on_dial(peer_index)`` and
+    ``on_send(peer_index, protocol, nbytes)`` that may delay (inject
+    latency) or raise OSError/ConnectionError (drop the dial/frame)."""
 
     def __init__(self, self_index: int, peers: list[Peer],
                  node_identity: ident.NodeIdentity,
                  peer_pubkeys: dict[int, bytes],
-                 cluster_hash: bytes = b"", registry=None):
+                 cluster_hash: bytes = b"", registry=None, faults=None,
+                 rng=None, backoff_base: float = 0.1,
+                 backoff_factor: float = 1.6, backoff_jitter: float = 0.2,
+                 backoff_ceiling: float = 30.0):
         self.self_index = self_index
         self.peers = {p.index: p for p in peers if p.index != self_index}
         self.self_peer = next(p for p in peers if p.index == self_index)
@@ -164,6 +182,13 @@ class TCPMesh:
         # logs + p2p metrics.go counters); optional app.monitoring.Registry
         self.registry = registry
         self._ever_connected: set[int] = set()
+        # reconnect gate state: peer -> (not-before loop time, delay gen)
+        self._faults = faults
+        self._rng = rng
+        self._backoff_params = (backoff_base, backoff_factor, backoff_jitter,
+                                backoff_ceiling)
+        self._backoff: dict[int, tuple[float, object]] = {}
+        self.dial_attempts: dict[int, int] = {}  # storm witness for tests
 
     # -- metrics helpers ----------------------------------------------------
 
@@ -338,23 +363,56 @@ class TCPMesh:
         self._msg_id += 1
         return (self.self_index << 48) | self._msg_id
 
+    async def _dial(self, peer: Peer):
+        """The raw socket connect — factored out so chaos fault injection
+        and socket-free reconnect tests can stub it."""
+        return await asyncio.open_connection(peer.host, peer.port)
+
     async def _connect(self, peer_index: int) -> _Channel:
         lock = self._conn_locks.setdefault(peer_index, asyncio.Lock())
         async with lock:
             ch = self._channels.get(peer_index)
             if ch is not None and not ch.writer.is_closing():
                 return ch
+            now = asyncio.get_event_loop().time()
+            state = self._backoff.get(peer_index)
+            if state is not None and now < state[0]:
+                # gate closed: fail fast, do NOT redial (see class doc)
+                raise ConnectionError(
+                    f"peer {peer_index} in reconnect backoff for "
+                    f"{state[0] - now:.2f}s")
             peer = self.peers[peer_index]
-            reader, writer = await asyncio.open_connection(peer.host,
-                                                           peer.port)
+            self.dial_attempts[peer_index] = (
+                self.dial_attempts.get(peer_index, 0) + 1)
+            writer = None
             try:
+                if self._faults is not None:
+                    await self._faults.on_dial(peer_index)
+                reader, writer = await self._dial(peer)
                 ch = await self._handshake_initiator(reader, writer,
                                                      peer_index)
-            except (ConnectionError, asyncio.IncompleteReadError,
+            except (OSError, asyncio.IncompleteReadError,
                     asyncio.TimeoutError) as e:
-                writer.close()
-                self._count_handshake_failure(str(peer_index))
-                raise ConnectionError(f"handshake with {peer_index}: {e}")
+                # app.retry is the canonical expbackoff helper; imported
+                # at the use site so this lower layer never participates
+                # in app's import-time graph
+                from ..app.retry import backoff_delays
+
+                if writer is not None:
+                    writer.close()
+                    self._count_handshake_failure(str(peer_index))
+                base, factor, jitter, ceiling = self._backoff_params
+                delays = (state[1] if state is not None else backoff_delays(
+                    base=base, factor=factor, jitter=jitter,
+                    max_delay=ceiling, rng=self._rng))
+                # gate deadline from the FAILURE instant, not the dial
+                # start: a dial that burns its whole timeout (silently
+                # dropped SYNs, handshake timeout) would otherwise leave
+                # the gate pre-expired and the storm protection inert
+                self._backoff[peer_index] = (
+                    asyncio.get_event_loop().time() + next(delays), delays)
+                raise ConnectionError(f"connect to {peer_index}: {e}")
+            self._backoff.pop(peer_index, None)
             if self.registry is not None:
                 if peer_index in self._ever_connected:
                     self.registry.inc("app_p2p_reconnects_total",
@@ -376,6 +434,8 @@ class TCPMesh:
                           payload: bytes, msg_id: int, is_reply: bool):
         t0 = asyncio.get_event_loop().time()
         ch = await self._connect(peer_index)
+        if self._faults is not None:
+            await self._faults.on_send(peer_index, protocol, len(payload))
         frame = ch.seal(self._encode_body(protocol, payload, msg_id,
                                           is_reply))
         ch.writer.write(frame)
@@ -402,6 +462,10 @@ class TCPMesh:
         finally:
             if writer in self._raw_writers:
                 self._raw_writers.remove(writer)
+        # a successful inbound handshake proves the peer is back: open
+        # the reconnect gate so outbound sends stop fast-failing for the
+        # rest of a (possibly ceiling-length) backoff window
+        self._backoff.pop(ch.peer_index, None)
         self._inbound.append(ch)
         await self._read_loop(ch)
 
