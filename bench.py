@@ -287,6 +287,10 @@ def main() -> None:
         # vs cache-cold resident throughput, cross-duty packing
         configs += _run_resident_ab_configs(
             api, rng, verify_entries_for, REPS)
+    # round 17: HTTP serving-layer load bench (aiohttp swarm vs the
+    # vapi router over an HTTP beaconmock) — no device work involved
+    if os.environ.get("CHARON_TPU_BENCH_SERVING", "1") != "0":
+        configs += _run_serving_configs()
 
     result = {
         "metric": "sigagg_latency_p99_ms",
@@ -354,7 +358,7 @@ def main() -> None:
     out = json.dumps(result)
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
-        path = os.path.join(repo_dir, "BENCH_r13.json")
+        path = os.path.join(repo_dir, "BENCH_r17.json")
         with open(path, "w") as fh:
             fh.write(out + "\n")
     except OSError:
@@ -805,6 +809,171 @@ def _run_resident_ab_configs(api, rng, verify_entries_for,
         "packed_entries": verifier.packed_entries,
     }
     return [entry]
+
+
+def _run_serving_configs(n_vc: int = 64, rounds: int = 5) -> list:
+    """Round 17: HTTP load bench of the validator-API serving layer —
+    an aiohttp client swarm against a live VapiRouter reverse-proxying a
+    real HTTP beaconmock.  Two arms:
+
+    - **coalesce** (nominal): `n_vc` concurrent VCs × `rounds` rounds of
+      the shared duty-data reads (spec, attester duties, validators
+      snapshot).  The single-flight cache must collapse the fan-in to a
+      handful of upstream fetches — asserted ≥ 5× reduction — and the
+      swarm sits below the admission bound, so ZERO 503s are allowed.
+    - **overload**: the duties class is pinned to 2 concurrent + 2
+      queued over a 50 ms-slow upstream while 32 clients hit DISTINCT
+      epochs (cache-defeating).  Admission control must shed with
+      503 + Retry-After instead of piling latency.
+
+    Both arms report RPS, p50/p99 and per-endpoint breakdowns; the
+    coalesce arm's rps / p99 / ratio ride the bench-trend gate."""
+    import asyncio
+    import time
+
+    from charon_tpu.app.router import VapiRouter
+    from charon_tpu.app.serving import ServingConfig
+    from charon_tpu.core.types import pubkey_from_bytes
+    from charon_tpu.core.validatorapi import ValidatorAPI
+    from charon_tpu.testutil.beaconmock import BeaconMock
+    from charon_tpu.testutil.beaconmock_http import BeaconMockServer
+
+    import aiohttp
+
+    UPSTREAM_LAT = 0.02     # injected upstream latency (coalesce window)
+
+    def _percentile(sorted_times, q):
+        return sorted_times[min(len(sorted_times) - 1,
+                                int(len(sorted_times) * q))]
+
+    async def _mk_stack(serving_config, latency):
+        bmock = BeaconMock(slot_duration=1.0, slots_per_epoch=8)
+        for i in range(4):
+            bmock.add_validator(pubkey_from_bytes(
+                bytes([0xC0, i + 1]) + bytes(46)))
+
+        async def _stall(*_a):
+            await asyncio.sleep(latency)
+            return None          # fall through to the default handler
+
+        bmock.overrides["attester_duties"] = _stall
+        server = BeaconMockServer(bmock)
+        await server.start()
+        vapi = ValidatorAPI(share_idx=1, pubshare_by_group={},
+                            fork_version=bytes(4))
+        router = VapiRouter(vapi, server.addr,
+                            serving_config=serving_config)
+        await router.start()
+        return server, router
+
+    async def _coalesce_arm():
+        server, router = await _mk_stack(ServingConfig(), UPSTREAM_LAT)
+        lat: dict[str, list] = {"metadata": [], "duties": [],
+                                "validators": []}
+        statuses: list[int] = []
+
+        async def one_vc():
+            async with aiohttp.ClientSession() as s:
+                for _ in range(rounds):
+                    for ep, coro in (
+                            ("metadata", s.get(
+                                router.addr + "/eth/v1/config/spec")),
+                            ("duties", s.post(
+                                router.addr
+                                + "/eth/v1/validator/duties/attester/0",
+                                json=["0", "1", "2", "3"])),
+                            ("validators", s.post(
+                                router.addr
+                                + "/eth/v1/beacon/states/head/validators",
+                                json={"ids": ["0", "1", "2", "3"]}))):
+                        t0 = time.perf_counter()
+                        async with coro as resp:
+                            await resp.read()
+                            statuses.append(resp.status)
+                        lat[ep].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one_vc() for _ in range(n_vc)])
+        wall = time.perf_counter() - t0
+        upstream = len(server.requests)
+        total = len(statuses)
+        stats = router.cache.stats()
+        await router.stop()
+        await server.stop()
+
+        assert all(st == 200 for st in statuses), \
+            f"non-200 under the admission bound: {sorted(set(statuses))}"
+        shed = sum(router.admission.shed.values())
+        assert shed == 0, f"{shed} sheds below the admission bound"
+        ratio = total / max(1, upstream)
+        assert ratio >= 5.0, \
+            f"coalesce ratio {ratio:.1f}x < 5x ({upstream} upstream " \
+            f"fetches for {total} client requests)"
+        times = sorted(t for ts in lat.values() for t in ts)
+        return {
+            "config": f"serving-coalesce-{n_vc}vc",
+            "clients": n_vc, "rounds": rounds, "requests": total,
+            "upstream_latency_ms": UPSTREAM_LAT * 1e3,
+            "wall_ms": round(wall * 1e3, 3),
+            "rps": round(total / wall, 1),
+            "p50_ms": round(_percentile(times, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(times, 0.99) * 1e3, 3),
+            "per_endpoint": {
+                ep: {"p50_ms": round(_percentile(sorted(ts), 0.50) * 1e3, 3),
+                     "p99_ms": round(_percentile(sorted(ts), 0.99) * 1e3, 3),
+                     **stats.get(ep, {})}
+                for ep, ts in lat.items()},
+            "upstream_fetches": upstream,
+            "coalesce_ratio": round(ratio, 1),
+            "shed": 0,
+        }
+
+    async def _overload_arm():
+        cfg = ServingConfig(admission_limits={"duties": (2, 2)},
+                            retry_after=1.0)
+        server, router = await _mk_stack(cfg, 0.05)
+        results: list[tuple[int, str | None]] = []
+
+        async def one_shot(k):
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        router.addr
+                        + f"/eth/v1/validator/duties/attester/{k}",
+                        json=["0"]) as resp:
+                    await resp.read()
+                    results.append((resp.status,
+                                    resp.headers.get("Retry-After")))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one_shot(k) for k in range(32)])
+        wall = time.perf_counter() - t0
+        shed = sum(router.admission.shed.values())
+        await router.stop()
+        await server.stop()
+
+        codes = [st for st, _ in results]
+        n503 = codes.count(503)
+        assert n503 > 0 and shed == n503, \
+            f"overload arm never shed ({codes})"
+        assert all(ra is not None for st, ra in results if st == 503), \
+            "503 without Retry-After"
+        assert all(st in (200, 503) for st in codes), f"unexpected {codes}"
+        return {
+            "config": "serving-overload-shed",
+            "clients": 32, "limit": 2, "queue": 2,
+            "upstream_latency_ms": 50.0,
+            "wall_ms": round(wall * 1e3, 3),
+            "requests": len(codes),
+            "served": codes.count(200),
+            "shed": n503,
+            "shed_rate": round(n503 / len(codes), 3),
+            "retry_after_seen": True,
+        }
+
+    async def _arms():
+        return [await _coalesce_arm(), await _overload_arm()]
+
+    return asyncio.run(_arms())
 
 
 def _dkg_share_verify_workload(rng):

@@ -64,6 +64,20 @@ def _max_config_overlap(parsed: dict):
     return best
 
 
+def _serving_field(key: str):
+    """Pull `key` from the serving-layer coalesce arm (round 17's HTTP
+    load bench config, named serving-coalesce-<N>vc)."""
+
+    def get(parsed: dict):
+        for c in parsed.get("configs") or []:
+            name = c.get("config", "")
+            if name.startswith("serving-coalesce-"):
+                return c.get(key)
+        return None
+
+    return get
+
+
 #: The gated series.  Keys must stay stable: BENCH_TREND.json consumers
 #: and the regression gate key on them.
 TRACKED: tuple[TrendMetric, ...] = (
@@ -81,6 +95,10 @@ TRACKED: tuple[TrendMetric, ...] = (
                 _dispatch_field("first_duty_verify_ms")),
     TrendMetric("first_duty_combine_ms", False, "ms",
                 _dispatch_field("first_duty_combine_ms")),
+    TrendMetric("serving_rps", True, "req/s", _serving_field("rps")),
+    TrendMetric("serving_p99_ms", False, "ms", _serving_field("p99_ms")),
+    TrendMetric("serving_coalesce_ratio", True, "x",
+                _serving_field("coalesce_ratio")),
 )
 
 

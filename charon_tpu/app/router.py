@@ -9,23 +9,44 @@ Mirrors reference core/validatorapi/router.go:
   query ids to group ids in requests,
 - everything else is reverse-proxied verbatim to the upstream beacon node
   (router.go:771-829).
+
+The serving layer (app/serving.py) sits across all three paths:
+duty-data fetches are coalesced and slot/epoch-scoped cached, every
+request passes per-endpoint admission control (503 + Retry-After past
+the queue bound), proxy bodies stream instead of buffering, and the
+whole surface exports ``app_vapi_*`` request/latency/inflight/shed
+metrics plus spans joining the duty trace.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import copy
+import time
 
 import aiohttp
 from aiohttp import web
 
-from ..core.types import PubKey
+from ..core.types import Duty, DutyType, PubKey
 from ..core.validatorapi import ValidatorAPI, VapiError
 from ..eth2util import beaconapi as api
+from ..eth2util.beacon_client import BeaconApiError
+from . import serving
+from .tracing import duty_trace_id
 
 
 _HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
                 "keep-alive", "te", "trailers", "upgrade",
                 "proxy-authorization", "proxy-authenticate"}
+
+#: Chain metadata the proxy may cache forever (immutable per network);
+#: everything else streams through verbatim.
+_IMMORTAL_PATHS = ("/eth/v1/beacon/genesis", "/eth/v1/config/spec",
+                   "/eth/v1/config/fork_schedule",
+                   "/eth/v1/config/deposit_contract")
+
+_CODE_CLASS = {1: "1xx", 2: "2xx", 3: "3xx", 4: "4xx", 5: "5xx"}
 
 
 class VapiRouter:
@@ -34,20 +55,38 @@ class VapiRouter:
     def __init__(self, vapi: ValidatorAPI, beacon_addr: str,
                  pubkey_by_index=None, host: str = "127.0.0.1",
                  port: int = 0, fee_recipient: str = "0x" + "00" * 20,
-                 builder_api: bool = False):
+                 builder_api: bool = False, registry=None, tracer=None,
+                 serving_config: "serving.ServingConfig | None" = None):
         """`beacon_addr` is the upstream BN base URL for the proxy;
         `pubkey_by_index` optionally resolves validator_index → group
-        PubKey (used by voluntary exits, reference SubmitVoluntaryExit)."""
+        PubKey (used by voluntary exits, reference SubmitVoluntaryExit).
+        `registry`/`tracer` feed the serving-layer metrics and duty-trace
+        spans; `serving_config` tunes cache TTLs and admission bounds."""
         self.vapi = vapi
         self.beacon_addr = beacon_addr.rstrip("/")
         self._pubkey_by_index = pubkey_by_index
         self.fee_recipient = fee_recipient
         self.builder_api = builder_api
         self._host, self._port = host, port
+        self._registry = registry
+        self._tracer = tracer
         self._runner: web.AppRunner | None = None
         self._proxy_session: aiohttp.ClientSession | None = None
         self.addr = ""
         self.proxied: list[str] = []  # proxied request log (assertion point)
+
+        self.serving_cfg = serving_config or serving.ServingConfig()
+        cfg = self.serving_cfg
+        self.cache = serving.SingleFlightCache(
+            max_entries=cfg.max_entries, registry=registry)
+        self.admission = serving.AdmissionController(
+            limits=cfg.admission_limits, default_limit=cfg.default_limit,
+            default_queue=cfg.default_queue, max_wait=cfg.max_wait,
+            retry_after=cfg.retry_after, registry=registry)
+        #: plain request counters keyed (endpoint, code class) — the
+        #: bench/test assertion point next to the registry metrics
+        self.requests: dict = {}
+        vapi.attach_serving_cache(self.cache, ttl=cfg.att_data_ttl)
 
         app = web.Application()
         r = app.router
@@ -84,13 +123,76 @@ class VapiRouter:
                    self._duties_mapped)
         # -- reverse proxy for the rest (router.go:771-829) -----------------
         r.add_route("*", "/{tail:.*}", self._proxy)
+        # admit_mw is OUTERMOST (first in the list): it sheds before any
+        # handler work and records the status every path produced,
+        # including the error bodies _error_mw materialises.
+        app.middlewares.append(self._admit_mw)
         app.middlewares.append(self._error_mw)
         self._app = app
 
     @web.middleware
+    async def _admit_mw(self, request: web.Request, handler):
+        """Admission control + request accounting + duty-trace span for
+        every request (intercepted, mapped and proxied alike)."""
+        ep = serving.endpoint_class(request.method, request.path)
+        t0 = time.monotonic()
+        span = (self._tracer.start_span(
+                    "vapi/" + ep, trace_id=self._duty_trace_for(request),
+                    method=request.method, path=request.path)
+                if self._tracer is not None else contextlib.nullcontext())
+        with span:
+            try:
+                async with self.admission.admit(ep):
+                    resp = await handler(request)
+            except serving.ShedError as e:
+                self._record(ep, 503, t0)
+                return web.json_response(
+                    {"code": 503,
+                     "message": "serving capacity exceeded, retry later"},
+                    status=503,
+                    headers={"Retry-After": str(int(e.retry_after) or 1)})
+            except web.HTTPException as e:
+                self._record(ep, e.status, t0)
+                raise
+        self._record(ep, resp.status, t0)
+        return resp
+
+    def _record(self, ep: str, status: int, t0: float) -> None:
+        code = _CODE_CLASS.get(status // 100, "other")
+        self.requests[(ep, code)] = self.requests.get((ep, code), 0) + 1
+        if self._registry is None:
+            return
+        self._registry.inc("app_vapi_requests_total",
+                           labels={"endpoint": ep, "code": code})
+        self._registry.observe("app_vapi_request_seconds",
+                               time.monotonic() - t0,
+                               labels={"endpoint": ep})
+
+    def _duty_trace_for(self, request: web.Request) -> str | None:
+        """Join the cluster-wide duty trace when the request addresses a
+        specific duty (reference: core/tracing.go duty-deterministic
+        trace IDs): attestation endpoints key on the slot query param,
+        proposal endpoints on the slot path segment."""
+        try:
+            path = request.path
+            if ("/validator/attestation_data" in path
+                    or "/validator/aggregate_attestation" in path):
+                return duty_trace_id(
+                    Duty(int(request.query["slot"]), DutyType.ATTESTER))
+            if "/blocks/" in path or "/blinded_blocks/" in path:
+                slot = request.match_info.get("slot")
+                if slot is not None:
+                    return duty_trace_id(Duty(int(slot), DutyType.PROPOSER))
+        except (KeyError, ValueError):
+            return None
+        return None
+
+    @web.middleware
     async def _error_mw(self, request: web.Request, handler):
         """Beacon-API error convention: {"code": N, "message": ...}
-        (reference: router.go writeError)."""
+        (reference: router.go writeError).  Upstream beacon failures map
+        to 502 — the node's own fault surface is 4xx/504, a broken BN
+        behind it must not masquerade as a router bug."""
         try:
             return await handler(request)
         except web.HTTPException:
@@ -98,13 +200,28 @@ class VapiRouter:
         except (VapiError, ValueError, KeyError) as e:
             return web.json_response({"code": 400, "message": str(e)},
                                      status=400)
+        except BeaconApiError as e:
+            return web.json_response(
+                {"code": 502, "message": f"upstream beacon error: {e}"},
+                status=502)
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"code": 502,
+                 "message": f"upstream beacon unreachable: {e}"},
+                status=502)
         except asyncio.TimeoutError:
             return web.json_response({"code": 504, "message": "timeout"},
                                      status=504)
 
     async def start(self) -> None:
+        # one pooled session for every upstream edge: mapped fetches,
+        # cacheable metadata and the streaming proxy all share its
+        # connection pool (reference: eth2wrap's shared http.Client)
         self._proxy_session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=30))
+            timeout=aiohttp.ClientTimeout(total=30),
+            connector=aiohttp.TCPConnector(
+                limit=self.serving_cfg.pool_limit,
+                limit_per_host=self.serving_cfg.pool_limit))
         self._runner = web.AppRunner(self._app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)
@@ -133,6 +250,26 @@ class VapiRouter:
             return str(pk)
         except (VapiError, ValueError):
             return share_hex
+
+    def _multi_params(self, request: web.Request,
+                      map_ids: bool = False) -> list[tuple[str, str]]:
+        """Rebuild the query string as a multi-value list: the beacon API
+        allows REPEATED params as well as comma-separated values, and
+        ``dict(request.query)`` silently drops all but the first repeat
+        (round-3 advisor finding, fixed in _validators; now shared with
+        _duties_mapped).  With `map_ids`, pubshare hex values under the
+        ``id`` key are rewritten to group pubkeys."""
+        params: list[tuple[str, str]] = []
+        for key in dict.fromkeys(request.query.keys()):
+            values = request.query.getall(key)
+            if map_ids and key == "id":
+                mapped = ",".join(
+                    self._group_for_share(i) if i.startswith("0x") else i
+                    for raw in values for i in raw.split(","))
+                params.append((key, mapped))
+            else:
+                params.extend((key, v) for v in values)
+        return params
 
     # -- intercepted handlers -----------------------------------------------
 
@@ -232,7 +369,8 @@ class VapiRouter:
     async def _validators(self, request) -> web.Response:
         """Map pubshare ids → group ids upstream, group pubkeys → pubshares
         downstream (reference: validatorapi.go getValidators pubshare
-        mapping)."""
+        mapping).  The upstream snapshot is coalesced + cached per
+        distinct id-set."""
         state = request.match_info["state"]
         if request.method == "POST":
             body = await request.json()
@@ -240,25 +378,16 @@ class VapiRouter:
                    for i in body.get("ids", [])]
             upstream = await self._upstream_json(
                 "POST", f"/eth/v1/beacon/states/{state}/validators",
-                json_body={"ids": ids})
+                json_body={"ids": ids},
+                cache=("validators", (state, tuple(ids))),
+                ttl=self.serving_cfg.validators_ttl)
         else:
-            # the beacon API allows REPEATED id= params as well as
-            # comma-separated values; dict(query) would drop all but the
-            # first repeat (round-3 advisor finding) — rebuild as a
-            # multi-value list instead.
-            params: list[tuple[str, str]] = []
-            for key in dict.fromkeys(request.query.keys()):
-                values = request.query.getall(key)
-                if key == "id":
-                    mapped = ",".join(
-                        self._group_for_share(i) if i.startswith("0x") else i
-                        for raw in values for i in raw.split(","))
-                    params.append((key, mapped))
-                else:
-                    params.extend((key, v) for v in values)
+            params = self._multi_params(request, map_ids=True)
             upstream = await self._upstream_json(
                 "GET", f"/eth/v1/beacon/states/{state}/validators",
-                params=params)
+                params=params,
+                cache=("validators", (state, tuple(params))),
+                ttl=self.serving_cfg.validators_ttl)
         for v in upstream.get("data", []):
             v["validator"]["pubkey"] = self._share_for_group(
                 v["validator"]["pubkey"])
@@ -266,45 +395,89 @@ class VapiRouter:
 
     async def _duties_mapped(self, request) -> web.Response:
         """Forward duties requests, rewriting group pubkeys → pubshares in
-        the response so the VC recognises its keys."""
+        the response so the VC recognises its keys.  N VCs asking for one
+        epoch's duties share a single coalesced, epoch-TTL'd upstream
+        fetch."""
         path = request.path
         if request.method == "POST":
+            body = await request.json()
             upstream = await self._upstream_json(
-                "POST", path, json_body=await request.json())
+                "POST", path, json_body=body,
+                cache=("duties", (path, tuple(
+                    body if isinstance(body, list) else [repr(body)]))),
+                ttl=self.serving_cfg.duties_ttl)
         else:
+            params = self._multi_params(request)
             upstream = await self._upstream_json(
-                "GET", path, params=dict(request.query))
+                "GET", path, params=params,
+                cache=("duties", (path, tuple(params))),
+                ttl=self.serving_cfg.duties_ttl)
         for d in upstream.get("data", []):
             if "pubkey" in d:
                 d["pubkey"] = self._share_for_group(d["pubkey"])
         return web.json_response(upstream)
 
     async def _upstream_json(self, method: str, path: str,
-                             params: dict | None = None,
-                             json_body=None) -> dict:
+                             params=None, json_body=None,
+                             cache: tuple | None = None,
+                             ttl: float | None = None) -> dict:
+        """One upstream JSON fetch, optionally coalesced + cached under
+        `cache=(endpoint, key)`.  Cached payloads are deep-copied out so
+        per-request pubkey rewrites never mutate the shared entry."""
         url = self.beacon_addr + path
-        async with self._proxy_session.request(
-                method, url, params=params, json=json_body) as resp:
-            if resp.status != 200:
-                raise web.HTTPBadGateway(
-                    text=f"upstream {resp.status}: {await resp.text()}")
-            return await resp.json()
+
+        async def fetch() -> dict:
+            async with self._proxy_session.request(
+                    method, url, params=params, json=json_body) as resp:
+                if resp.status != 200:
+                    raise BeaconApiError(resp.status, await resp.text(), url)
+                return await resp.json()
+
+        if cache is None:
+            return await fetch()
+        endpoint, key = cache
+        out = await self.cache.get(endpoint, key, fetch, ttl=ttl)
+        return copy.deepcopy(out)
 
     # -- reverse proxy ------------------------------------------------------
 
-    async def _proxy(self, request: web.Request) -> web.Response:
-        """Verbatim reverse proxy to the beacon node
-        (reference: router.go:771-829 proxyHandler)."""
+    async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        """Reverse proxy to the beacon node (reference:
+        router.go:771-829 proxyHandler).  Immutable chain metadata is
+        served from the coalescing cache; everything else STREAMS both
+        directions — request and response bodies never buffer fully in
+        memory (the previous read()/read() pair held every payload twice
+        per in-flight request)."""
         self.proxied.append(f"{request.method} {request.path}")
+        if (request.method == "GET" and not request.query_string
+                and request.path in _IMMORTAL_PATHS):
+            ctype, body = await self.cache.get(
+                "metadata", request.path, lambda: self._fetch_raw(request))
+            return web.Response(status=200, body=body,
+                                headers={"Content-Type": ctype})
         url = self.beacon_addr + request.path_qs
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
-        body = await request.read()
+        data = request.content if request.can_read_body else None
         async with self._proxy_session.request(
-                request.method, url, headers=headers,
-                data=body if body else None) as resp:
-            payload = await resp.read()
+                request.method, url, headers=headers, data=data) as resp:
             out_headers = {k: v for k, v in resp.headers.items()
                            if k.lower() not in _HOP_HEADERS}
-            return web.Response(status=resp.status, body=payload,
-                                headers=out_headers)
+            out = web.StreamResponse(status=resp.status, headers=out_headers)
+            await out.prepare(request)
+            async for chunk in resp.content.iter_chunked(1 << 16):
+                await out.write(chunk)
+            await out.write_eof()
+            return out
+
+    async def _fetch_raw(self, request: web.Request) -> tuple:
+        """Body fetch for the cacheable metadata paths; non-200 raises so
+        failures reject the coalesced waiters without being cached."""
+        url = self.beacon_addr + request.path
+        async with self._proxy_session.get(url) as resp:
+            body = await resp.read()
+            if resp.status != 200:
+                raise BeaconApiError(resp.status,
+                                     body.decode("utf-8", "replace"), url)
+            return (resp.headers.get("Content-Type", "application/json"),
+                    body)

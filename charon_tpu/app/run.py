@@ -41,6 +41,7 @@ from ..core.types import (Duty, DutyType, ParSignedDataSet, PubKey,
 from ..core.validatorapi import ValidatorAPI
 from ..core.verify import BatchVerifier
 from ..eth2util.beacon_client import MultiBeaconClient
+from .serving import CachingBeaconClient
 from ..eth2util.signing import signing_root
 from ..p2p import identity as ident
 from ..p2p.protocols import (P2PConsensusTransport, P2PParSigEx,
@@ -140,8 +141,15 @@ class App:
                             registry=self.registry)
         self.mesh.enable_ping_responder()
 
-        # 4. beacon client + chain parameters
-        self.eth2cl = MultiBeaconClient.from_urls(cfg.beacon_urls)
+        # 4. beacon client + chain parameters: the multi-client fan-out
+        #    exports per-node request metrics, and the serving-layer
+        #    cache wraps it so scheduler/fetcher duty fetches are
+        #    coalesced and slot/epoch-scoped cached (with bounded
+        #    retries absorbing a flapping upstream)
+        multi = MultiBeaconClient.from_urls(cfg.beacon_urls)
+        multi.bind_registry(self.registry)
+        self.eth2cl = CachingBeaconClient(multi, registry=self.registry,
+                                          retries=2)
         spec = await self.eth2cl.spec()
         self.slot_duration = spec["SECONDS_PER_SLOT"]
         self.slots_per_epoch = spec["SLOTS_PER_EPOCH"]
@@ -352,7 +360,9 @@ class App:
         self._index_to_pubkey: dict[int, PubKey] = {}
         self.router = VapiRouter(vapi, cfg.beacon_urls[0],
                                  pubkey_by_index=self._pubkey_by_index,
-                                 host=cfg.vapi_host, port=cfg.vapi_port)
+                                 host=cfg.vapi_host, port=cfg.vapi_port,
+                                 registry=self.registry,
+                                 tracer=self.tracer_spans)
 
         # 13. optional in-process validator mock (simnet,
         #     reference: app/vmock.go)

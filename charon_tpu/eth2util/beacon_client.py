@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import json
 import time
 
 import aiohttp
@@ -89,7 +90,7 @@ class BeaconClient:
             if resp.status not in (200, 202):
                 raise BeaconApiError(resp.status, await resp.text(), url)
             text = await resp.text()
-            return {} if not text else __import__("json").loads(text)
+            return {} if not text else json.loads(text)
 
     # -- chain metadata -----------------------------------------------------
 
@@ -251,6 +252,15 @@ class MultiBeaconClient:
         self.clients = clients
         self.errors: dict[str, int] = {c.base_url: 0 for c in clients}
         self.latency: dict[str, float] = {c.base_url: 0.0 for c in clients}
+        self._registry = None
+
+    def bind_registry(self, registry) -> None:
+        """Export per-node request stats as real metrics
+        (``app_beacon_requests_total{node,result}`` +
+        ``app_beacon_request_seconds{node}``) — the errors/latency dicts
+        alone never reach /metrics (reference: eth2wrap.go:40-58
+        incError/observeLatency)."""
+        self._registry = registry
 
     @classmethod
     def from_urls(cls, urls: list[str], timeout: float = 10.0):
@@ -265,11 +275,24 @@ class MultiBeaconClient:
             t0 = time.monotonic()
             try:
                 out = await getattr(c, method)(*args, **kw)
-                self.latency[c.base_url] = time.monotonic() - t0
-                return out
+            except asyncio.CancelledError:
+                raise  # the fan-out loser, not a node failure
             except Exception:
                 self.errors[c.base_url] += 1
+                if self._registry is not None:
+                    self._registry.inc(
+                        "app_beacon_requests_total",
+                        labels={"node": c.base_url, "result": "error"})
                 raise
+            dt = time.monotonic() - t0
+            self.latency[c.base_url] = dt
+            if self._registry is not None:
+                self._registry.inc(
+                    "app_beacon_requests_total",
+                    labels={"node": c.base_url, "result": "ok"})
+                self._registry.observe("app_beacon_request_seconds", dt,
+                                       labels={"node": c.base_url})
+            return out
 
         if len(self.clients) == 1:
             return await call(self.clients[0])

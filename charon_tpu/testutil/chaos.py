@@ -55,12 +55,14 @@ from typing import Any, Callable, Optional
 
 from ..app.monitoring import Registry
 from ..app.node import Node, NodeConfig
+from ..app.serving import CachingBeaconClient
 from ..core import qbft
 from ..core import types as core_types
 from ..core.consensus import ConsensusMemNetwork, QBFTConsensus, duty_leader
 from ..core.deadline import LATE_FACTOR
 from ..core.parsigex import MemParSigExNetwork
 from ..core.types import Duty, DutyType, ParSignedData
+from ..eth2util.beacon_client import BeaconApiError
 from ..eth2util.signing import DomainName, signing_root
 from ..tbls import api as tbls
 from .beaconmock import AttesterDutyInfo, BeaconMock
@@ -218,6 +220,28 @@ class Byzantine:
     end_slot: int = 1 << 30
 
 
+#: BeaconFault modes
+BEACON_ERROR = "error"
+BEACON_FLAKY = "flaky"
+BEACON_SLOW = "slow"
+
+
+@dataclass(frozen=True)
+class BeaconFault:
+    """Upstream beacon-API fault for slots [start_slot, end_slot):
+    ``error`` fails every duty-data read, ``flaky`` fails each read with
+    probability `rate`, ``slow`` only stalls; `latency` seconds are
+    added to every read in all three modes.  Submissions are never
+    faulted — the scenario scopes the fault to the fetch path the
+    serving-layer cache/coalescer can absorb."""
+
+    start_slot: int
+    end_slot: int
+    mode: str = BEACON_FLAKY
+    rate: float = 0.5
+    latency: float = 0.0
+
+
 @dataclass(frozen=True)
 class FaultPlan:
     partitions: tuple = ()
@@ -226,6 +250,7 @@ class FaultPlan:
     crashes: tuple = ()
     restarts: tuple = ()
     byzantine: tuple = ()
+    beacon: tuple = ()
 
     def skew_of(self, node: int) -> float:
         for s in self.skews:
@@ -259,13 +284,19 @@ class FaultPlan:
         return {b.kind for b in self.byzantine
                 if b.node == node and b.start_slot <= slot < b.end_slot}
 
+    def beacon_fault(self, slot: int) -> Optional[BeaconFault]:
+        for bf in self.beacon:
+            if bf.start_slot <= slot < bf.end_slot:
+                return bf
+        return None
+
     def byz_equivocator_nodes(self) -> set:
         return {b.node for b in self.byzantine if b.kind == BYZ_EQUIVOCATE}
 
     def describe(self) -> str:
         parts = []
         for name in ("partitions", "links", "skews", "crashes", "restarts",
-                     "byzantine"):
+                     "byzantine", "beacon"):
             vals = getattr(self, name)
             if vals:
                 parts.append(f"{name}={list(vals)!r}")
@@ -676,6 +707,50 @@ def metric_label_values(reg: Registry, name: str,
 # Harness
 # ---------------------------------------------------------------------------
 
+#: Duty-data read methods subject to BeaconFault injection (submissions
+#: and liveness probes pass through untouched).
+_BEACON_READ_METHODS = frozenset((
+    "spec", "genesis_time", "genesis_validators_root", "active_validators",
+    "attester_duties", "proposer_duties", "sync_duties", "attestation_data",
+))
+
+
+class _FlakyBeacon:
+    """Duck-typed beacon-client wrapper that injects the plan's
+    BeaconFault into duty-data reads: optional stall plus scripted
+    failures (503) on faulted slots.  Deterministic per (seed, node)."""
+
+    def __init__(self, inner, plan: FaultPlan, rng: random.Random,
+                 slot_of) -> None:
+        self._inner = inner
+        self._plan = plan
+        self._rng = rng
+        self._slot_of = slot_of
+        self.injected = 0
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        attr = getattr(self._inner, name)
+        if name not in _BEACON_READ_METHODS or not callable(attr):
+            return attr
+
+        async def faulted(*args, **kwargs):
+            bf = self._plan.beacon_fault(self._slot_of())
+            if bf is not None:
+                if bf.latency > 0:
+                    await asyncio.sleep(bf.latency)
+                if bf.mode == BEACON_ERROR or (
+                        bf.mode == BEACON_FLAKY
+                        and self._rng.random() < bf.rate):
+                    self.injected += 1
+                    raise BeaconApiError(503, "injected beacon fault",
+                                         f"bmock/{name}")
+            return await attr(*args, **kwargs)
+
+        return faulted
+
+
 class _NodeSlot:
     """Mutable holder for one cluster position (survives restarts)."""
 
@@ -848,7 +923,18 @@ class ChaosHarness:
         cfg = NodeConfig(share_idx=idx + 1, threshold=scn.threshold,
                          pubshares_by_peer=self.pubshares_by_peer,
                          fork_version=FORK)
-        node = Node(cfg, self.bmock, consensus=consensus, parsigex=parsigex,
+        eth2cl = self.bmock
+        if self.plan.beacon:
+            flaky = _FlakyBeacon(
+                self.bmock, self.plan,
+                rng=random.Random((self.seed * 1000003) ^ (idx + 1)),
+                slot_of=self.router.slot_now)
+            eth2cl = CachingBeaconClient(
+                flaky, clock=clk, retries=8, retry_base=0.02,
+                rng=random.Random((self.seed * 7919) ^ (idx + 1)),
+                slot_duration=self.dur, slots_per_epoch=scn.spe,
+                genesis_time=0.0)
+        node = Node(cfg, eth2cl, consensus=consensus, parsigex=parsigex,
                     slots_per_epoch=scn.spe, genesis_time=0.0,
                     slot_duration=self.dur, registry=reg, clock=clk,
                     dutydb=dutydb, aggsigdb=aggsigdb, probes=False,
@@ -856,7 +942,7 @@ class ChaosHarness:
         vmock = ValidatorMock(node.vapi,
                               self.cluster.share_privkey_map(idx + 1),
                               FORK, slots_per_epoch=scn.spe,
-                              eth2cl=self.bmock)
+                              eth2cl=eth2cl)
         node.scheduler.subscribe_slots(vmock.on_slot)
         self._watch(idx, node, consensus)
         slot_holder.node = node
@@ -1287,6 +1373,11 @@ def _plan_parsigex_stall(scn: Scenario, rng: random.Random) -> FaultPlan:
     return FaultPlan(links=links)
 
 
+def _plan_beacon_flap(scn: Scenario, rng: random.Random) -> FaultPlan:
+    return FaultPlan(beacon=(
+        BeaconFault(10, 22, mode=BEACON_FLAKY, rate=0.35, latency=0.05),))
+
+
 def _plan_soak(scn: Scenario, rng: random.Random) -> FaultPlan:
     """Randomised mixed chaos: one fault window at a time (so a quorum
     always survives), drawn from the whole fault vocabulary."""
@@ -1366,6 +1457,11 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
              "0.8 s parsigex-link latency for 8 slots; the late-duty "
              "watchdog must blame the parsig_ex phase and nothing else",
              expect_late_phase="parsig_ex", min_late=3),
+    Scenario("beacon_flap", 32, _plan_beacon_flap,
+             "upstream beacon API flaps (35% error rate + 50 ms stall) "
+             "for 12 slots; the serving cache + single-flight retry "
+             "layer absorbs it and every duty still completes",
+             check_participation=True),
     Scenario("soak", 1200, _plan_soak,
              "randomised mixed chaos soak (slow lane): the whole fault "
              "vocabulary over 1200 slots"),

@@ -1,0 +1,558 @@
+"""Serving-layer tests (app/serving + router glue): single-flight
+coalescing, failure non-poisoning, slot-boundary invalidation under a
+fake clock, admission shedding (503 + Retry-After), repeated-query-param
+forwarding, beacon-API error mapping, and the per-node beacon metrics.
+Pure asyncio + aiohttp over in-process HTTP — no device work."""
+
+import asyncio
+import collections
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+import bench
+from charon_tpu.app import serving
+from charon_tpu.app.monitoring import Registry
+from charon_tpu.app.router import VapiRouter
+from charon_tpu.app.serving import (AdmissionController, CachingBeaconClient,
+                                    ServingConfig, ShedError,
+                                    SingleFlightCache, endpoint_class)
+from charon_tpu.core.validatorapi import ValidatorAPI
+from charon_tpu.eth2util.beacon_client import (BeaconApiError, BeaconClient,
+                                               MultiBeaconClient)
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.beaconmock_http import BeaconMockServer
+
+FORK = bytes(4)
+
+
+# ---------------------------------------------------------------------------
+# SingleFlightCache
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_waiters_share_one_fetch():
+    """N concurrent requesters of one key share ONE upstream fetch and
+    all observe its result; the counters attribute the fan-in."""
+
+    async def main():
+        reg = Registry()
+        cache = SingleFlightCache(registry=reg)
+        gate = asyncio.Event()
+        calls = []
+
+        async def fetch():
+            calls.append(1)
+            await gate.wait()
+            return {"v": len(calls)}
+
+        tasks = [asyncio.ensure_future(cache.get("duties", "k", fetch))
+                 for _ in range(16)]
+        await asyncio.sleep(0)      # let every waiter reach the cache
+        gate.set()
+        results = await asyncio.gather(*tasks)
+        assert len(calls) == 1
+        assert all(r == {"v": 1} for r in results)
+        st = cache.stats()["duties"]
+        assert st["misses"] == 1 and st["coalesced"] == 15
+        # a later request is a plain cache hit, still one fetch total
+        assert await cache.get("duties", "k", fetch) == {"v": 1}
+        assert len(calls) == 1 and cache.stats()["duties"]["hits"] == 1
+        out = reg.render()
+        assert "app_serving_coalesced_total" in out
+        assert "app_serving_cache_hits_total" in out
+        assert "app_serving_cache_misses_total" in out
+
+    asyncio.run(main())
+
+
+def test_failed_fetch_rejects_all_waiters_without_poisoning():
+    """A failed fetch propagates to EVERY coalesced waiter and caches
+    nothing — the next request starts a fresh fetch and succeeds."""
+
+    async def main():
+        cache = SingleFlightCache()
+        gate = asyncio.Event()
+        calls = []
+
+        async def fetch():
+            calls.append(1)
+            if len(calls) == 1:
+                await gate.wait()
+                raise BeaconApiError(503, "flap", "stub")
+            return "recovered"
+
+        tasks = [asyncio.ensure_future(cache.get("duties", "k", fetch))
+                 for _ in range(8)]
+        await asyncio.sleep(0)
+        gate.set()
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        assert len(calls) == 1
+        assert all(isinstance(r, BeaconApiError) for r in results)
+        # nothing cached: a fresh request re-fetches and succeeds
+        assert await cache.get("duties", "k", fetch) == "recovered"
+        assert len(calls) == 2
+        # and the recovery IS cached now
+        assert await cache.get("duties", "k", fetch) == "recovered"
+        assert len(calls) == 2
+
+    asyncio.run(main())
+
+
+def test_cancelled_waiter_does_not_kill_shared_fetch():
+    """asyncio.shield: one waiter's cancellation must not cancel the
+    in-flight fetch the other waiters share."""
+
+    async def main():
+        cache = SingleFlightCache()
+        gate = asyncio.Event()
+
+        async def fetch():
+            await gate.wait()
+            return "shared"
+
+        t1 = asyncio.ensure_future(cache.get("x", "k", fetch))
+        t2 = asyncio.ensure_future(cache.get("x", "k", fetch))
+        await asyncio.sleep(0)
+        t2.cancel()
+        gate.set()
+        assert await t1 == "shared"
+        with pytest.raises(asyncio.CancelledError):
+            await t2
+
+    asyncio.run(main())
+
+
+def test_lru_bound_evicts_oldest():
+    async def main():
+        cache = SingleFlightCache(max_entries=4)
+
+        async def fetch_v(k):
+            return k
+
+        for k in range(6):
+            await cache.get("x", k, lambda k=k: fetch_v(k))
+        assert len(cache._entries) == 4
+        # 0 and 1 evicted: re-requesting them is a miss, 5 is a hit
+        before = cache.stats()["x"]["misses"]
+        await cache.get("x", 5, lambda: fetch_v(5))
+        assert cache.stats()["x"]["misses"] == before
+        await cache.get("x", 0, lambda: fetch_v(0))
+        assert cache.stats()["x"]["misses"] == before + 1
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# CachingBeaconClient: fake-clock deadlines + retries
+# ---------------------------------------------------------------------------
+
+
+class _StubBeacon:
+    def __init__(self):
+        self.calls = collections.Counter()
+        self.fail_next = 0
+
+    async def spec(self):
+        self.calls["spec"] += 1
+        return {"SECONDS_PER_SLOT": 12.0, "SLOTS_PER_EPOCH": 32}
+
+    async def attestation_data(self, slot, committee_index):
+        self.calls["att"] += 1
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise BeaconApiError(503, "flap", "stub")
+        return {"slot": slot, "ci": committee_index,
+                "gen": self.calls["att"]}
+
+    async def attester_duties(self, epoch, indices):
+        self.calls["duties"] += 1
+        return [{"epoch": epoch, "gen": self.calls["duties"]}]
+
+    async def submit_attestations(self, atts):
+        self.calls["submit"] += 1
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_slot_boundary_never_serves_stale_attestation_data():
+    """Attestation data is cached only until ITS slot's boundary in the
+    injected clock's domain — at/after the boundary a fresh fetch runs,
+    never the stale value."""
+
+    async def main():
+        stub, clk = _StubBeacon(), _Clock(5.0)
+        cl = CachingBeaconClient(stub, clock=clk, slot_duration=12.0,
+                                 slots_per_epoch=32, genesis_time=0.0)
+        first = await cl.attestation_data(0, 1)
+        assert first["gen"] == 1
+        clk.t = 11.999          # still inside slot 0: cached
+        assert (await cl.attestation_data(0, 1))["gen"] == 1
+        clk.t = 12.0            # slot boundary: stale is DEAD
+        assert (await cl.attestation_data(0, 1))["gen"] == 2
+        assert stub.calls["att"] == 2
+        # duties die at their epoch boundary (epoch 0 ends at 384 s)
+        clk.t = 100.0
+        assert (await cl.attester_duties(0, [1, 2]))[0]["gen"] == 1
+        clk.t = 383.9
+        assert (await cl.attester_duties(0, [1, 2]))[0]["gen"] == 1
+        clk.t = 384.0
+        assert (await cl.attester_duties(0, [1, 2]))[0]["gen"] == 2
+        # spec is immortal; submissions pass through uncached
+        clk.t = 1e9
+        await cl.spec()
+        await cl.spec()
+        assert stub.calls["spec"] == 1
+        await cl.submit_attestations([])
+        await cl.submit_attestations([])
+        assert stub.calls["submit"] == 2
+
+    asyncio.run(main())
+
+
+def test_caching_client_bounded_retry_absorbs_flap():
+    async def main():
+        stub = _StubBeacon()
+        stub.fail_next = 2
+
+        async def no_sleep(_):
+            return None
+
+        cl = CachingBeaconClient(stub, retries=3, sleep=no_sleep)
+        out = await cl.attestation_data(7, 0)
+        assert out["slot"] == 7 and stub.calls["att"] == 3
+        # with retries exhausted the error propagates
+        stub2 = _StubBeacon()
+        stub2.fail_next = 5
+        cl2 = CachingBeaconClient(stub2, retries=1, sleep=no_sleep)
+        with pytest.raises(BeaconApiError):
+            await cl2.attestation_data(8, 0)
+        assert stub2.calls["att"] == 2
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_past_queue_bound():
+    async def main():
+        ctl = AdmissionController(limits={"duties": (1, 1)})
+        first = ctl.admit("duties")
+        await first.__aenter__()            # holds the single slot
+        waiter = asyncio.ensure_future(ctl.admit("duties").__aenter__())
+        await asyncio.sleep(0)              # fills the one queue slot
+        with pytest.raises(ShedError) as ei:
+            async with ctl.admit("duties"):
+                pass
+        assert ei.value.endpoint == "duties"
+        assert ctl.shed["duties"] == 1
+        await first.__aexit__(None, None, None)
+        adm = await waiter                  # queued request admitted
+        await adm.__aexit__(None, None, None)
+        assert ctl.admitted["duties"] == 2
+
+    asyncio.run(main())
+
+
+def test_endpoint_classes_are_bounded():
+    assert endpoint_class(
+        "GET", "/eth/v1/validator/attestation_data") == "attestation_data"
+    assert endpoint_class(
+        "POST", "/eth/v1/validator/duties/attester/3") == "duties"
+    assert endpoint_class(
+        "GET", "/eth/v1/beacon/states/head/validators") == "validators"
+    assert endpoint_class("GET", "/eth/v2/validator/blocks/5") == "block"
+    assert endpoint_class(
+        "GET", "/eth/v1/validator/aggregate_attestation") == "aggregate"
+    assert endpoint_class(
+        "POST", "/eth/v1/beacon/pool/sync_committees") == "submit"
+    assert endpoint_class("GET", "/eth/v1/config/spec") == "metadata"
+    assert endpoint_class("GET", "/eth/v1/node/version") == "proxy"
+
+
+# ---------------------------------------------------------------------------
+# Router over HTTP: param forwarding, shedding, error mapping
+# ---------------------------------------------------------------------------
+
+
+class _RecordingUpstream:
+    """Minimal upstream that records every request's multi-value query
+    and body — the assertion point for what the router FORWARDS."""
+
+    def __init__(self, status=200, delay=0.0):
+        self.calls = []     # (method, path, [(key, value)...], body)
+        self.status = status
+        self.delay = delay
+        self.addr = ""
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._app = app
+        self._runner = None
+
+    async def _handle(self, request):
+        params = [(k, v) for k in dict.fromkeys(request.query.keys())
+                  for v in request.query.getall(k)]
+        body = await request.text() if request.can_read_body else ""
+        self.calls.append((request.method, request.path, params, body))
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        if self.status != 200:
+            return web.json_response(
+                {"code": self.status, "message": "upstream boom"},
+                status=self.status)
+        return web.json_response({"data": []})
+
+    async def start(self):
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.addr = f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+
+def _mk_router(upstream_addr, serving_config=None, registry=None):
+    vapi = ValidatorAPI(share_idx=1, pubshare_by_group={},
+                        fork_version=FORK)
+    return VapiRouter(vapi, upstream_addr, serving_config=serving_config,
+                      registry=registry)
+
+
+def test_repeated_query_params_forwarded():
+    """The beacon API allows repeated query params; ``dict(query)``
+    silently drops all but the first.  Both mapped GET surfaces must
+    forward every occurrence (the _duties_mapped fix, shared helper)."""
+
+    async def main():
+        up = _RecordingUpstream()
+        await up.start()
+        router = _mk_router(up.addr)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                url = (router.addr
+                       + "/eth/v1/validator/duties/proposer/0"
+                       + "?index=1&index=2&status=a&status=b")
+                async with s.get(url) as resp:
+                    assert resp.status == 200
+                url = (router.addr
+                       + "/eth/v1/beacon/states/head/validators"
+                       + "?id=0&id=1&status=active_ongoing&status=exited")
+                async with s.get(url) as resp:
+                    assert resp.status == 200
+        finally:
+            await router.stop()
+            await up.stop()
+
+        (_, _, duty_params, _), (_, _, val_params, _) = up.calls
+        assert ("index", "1") in duty_params and ("index", "2") in duty_params
+        assert ("status", "a") in duty_params and ("status", "b") in duty_params
+        val_ids = [v for k, v in val_params if k == "id"]
+        assert sorted(",".join(val_ids).split(",")) == ["0", "1"]
+        statuses = [v for k, v in val_params if k == "status"]
+        assert statuses == ["active_ongoing", "exited"]
+
+    asyncio.run(main())
+
+
+def test_admission_shed_503_with_retry_after():
+    """Above the admission bound the router sheds with 503 +
+    Retry-After; below it (sequential requests) there are ZERO 503s."""
+
+    async def main():
+        up = _RecordingUpstream(delay=0.2)
+        await up.start()
+        cfg = ServingConfig(admission_limits={"duties": (1, 0)},
+                            retry_after=2.0)
+        router = _mk_router(up.addr, serving_config=cfg)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async def one(epoch):
+                    async with s.get(
+                            router.addr
+                            + f"/eth/v1/validator/duties/proposer/{epoch}"
+                            ) as resp:
+                        return resp.status, resp.headers.get("Retry-After")
+                results = await asyncio.gather(*[one(k) for k in range(4)])
+                codes = sorted(st for st, _ in results)
+                assert codes == [200, 503, 503, 503], codes
+                assert all(ra == "2" for st, ra in results if st == 503)
+                shed = sum(router.admission.shed.values())
+                assert shed == 3
+                # below the bound: sequential requests never shed
+                for epoch in range(10, 13):
+                    st, _ = await one(epoch)
+                    assert st == 200
+                assert sum(router.admission.shed.values()) == 3
+        finally:
+            await router.stop()
+            await up.stop()
+
+    asyncio.run(main())
+
+
+def test_upstream_errors_map_to_502():
+    """A broken BN must surface as 502 with a beacon-API error body —
+    not masquerade as a router 4xx/500."""
+
+    async def main():
+        up = _RecordingUpstream(status=500)
+        await up.start()
+        router = _mk_router(up.addr)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        router.addr
+                        + "/eth/v1/validator/duties/proposer/0") as resp:
+                    assert resp.status == 502
+                    body = await resp.json()
+                    assert body["code"] == 502
+                    assert "upstream beacon" in body["message"]
+        finally:
+            await router.stop()
+            await up.stop()
+
+        # unreachable upstream (refused connection) → 502 too
+        router = _mk_router("http://127.0.0.1:1")
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        router.addr
+                        + "/eth/v1/validator/duties/attester/0",
+                        json=["0"]) as resp:
+                    assert resp.status == 502
+                    assert (await resp.json())["code"] == 502
+        finally:
+            await router.stop()
+
+    asyncio.run(main())
+
+
+def test_metadata_proxy_coalesced_and_requests_metered():
+    """Immortal metadata rides the coalescing cache (one upstream fetch
+    for N requests) and every request lands in the app_vapi_* meters."""
+
+    async def main():
+        up = _RecordingUpstream()
+        await up.start()
+        reg = Registry()
+        router = _mk_router(up.addr, registry=reg)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                for _ in range(5):
+                    async with s.get(router.addr
+                                     + "/eth/v1/config/spec") as resp:
+                        assert resp.status == 200
+        finally:
+            await router.stop()
+            await up.stop()
+        assert len(up.calls) == 1, "metadata cache missed"
+        assert router.requests[("metadata", "2xx")] == 5
+        out = reg.render()
+        assert "app_vapi_requests_total" in out
+        assert "app_vapi_request_seconds" in out
+
+    asyncio.run(main())
+
+
+def test_vapi_attestation_data_coalesced():
+    """N VCs awaiting the same (slot, committee) attestation data share
+    ONE DutyDB wait through the attached serving cache."""
+
+    async def main():
+        vapi = ValidatorAPI(share_idx=1, pubshare_by_group={},
+                            fork_version=FORK)
+        cache = SingleFlightCache()
+        vapi.attach_serving_cache(cache, ttl=64.0)
+        gate = asyncio.Event()
+        calls = []
+
+        async def await_att(slot, ci):
+            calls.append((slot, ci))
+            await gate.wait()
+            return {"slot": slot, "ci": ci}
+
+        vapi.register_await_attestation(await_att)
+        tasks = [asyncio.ensure_future(vapi.attestation_data(9, 2))
+                 for _ in range(8)]
+        await asyncio.sleep(0)
+        gate.set()
+        results = await asyncio.gather(*tasks)
+        assert calls == [(9, 2)]
+        assert all(r == {"slot": 9, "ci": 2} for r in results)
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# MultiBeaconClient per-node metrics
+# ---------------------------------------------------------------------------
+
+
+def test_multi_beacon_client_exports_node_metrics():
+    async def main():
+        bmock = BeaconMock(slot_duration=1.0, slots_per_epoch=8)
+        server = BeaconMockServer(bmock)
+        await server.start()
+        reg = Registry()
+        multi = MultiBeaconClient.from_urls([server.addr], timeout=5.0)
+        multi.bind_registry(reg)
+        try:
+            assert await multi.genesis_time() == pytest.approx(bmock.genesis)
+            await multi.spec()
+        finally:
+            await multi.close()
+            await server.stop()
+        out = reg.render()
+        assert "app_beacon_requests_total" in out and 'result="ok"' in out
+        assert "app_beacon_request_seconds" in out
+        assert server.addr in out          # node label carries the base URL
+
+        # a dead node records result="error"
+        reg2 = Registry()
+        dead = MultiBeaconClient([BeaconClient("http://127.0.0.1:1",
+                                               timeout=1.0)])
+        dead.bind_registry(reg2)
+        with pytest.raises(Exception):
+            await dead.genesis_time()
+        await dead.close()
+        assert 'result="error"' in reg2.render()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# The bench's serving arms (the acceptance numbers, pinned in tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serving_coalesce_and_shed_arms():
+    """bench.py's round-17 serving configs: ≥5× upstream-fetch reduction
+    at 64 concurrent VCs with zero sheds in the nominal arm, and a
+    shedding overload arm with Retry-After on every 503 (both asserted
+    inside the bench itself)."""
+    cfgs = bench._run_serving_configs(n_vc=64, rounds=2)
+    by_name = {c["config"]: c for c in cfgs}
+    nominal = by_name["serving-coalesce-64vc"]
+    assert nominal["coalesce_ratio"] >= 5.0
+    assert nominal["shed"] == 0
+    assert nominal["rps"] > 0 and nominal["p99_ms"] > 0
+    overload = by_name["serving-overload-shed"]
+    assert overload["shed"] > 0 and overload["retry_after_seen"]
