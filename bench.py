@@ -50,8 +50,14 @@ def _preflight_audit(v: int, t: int) -> None:
     and refuse to start against an unauditable kernel set.  The round-5
     bench burned a full TPU session discovering at AOT-compile time that
     its kernel needed 17.48 MiB of scoped VMEM; the same violation is now
-    a preflight error before any device work.  CHARON_TPU_PREFLIGHT=0
-    skips (e.g. when iterating on a knowingly-dirty kernel)."""
+    a preflight error before any device work.  The concurrency passes
+    (lock discipline + asyncio lint) ride along: a bench that launches
+    the dispatch pipeline against an unguarded shared-state mutation
+    would measure a race, not a kernel.  CHARON_TPU_PREFLIGHT=0
+    skips (e.g. when iterating on a knowingly-dirty kernel).
+    CHARON_TPU_PREFLIGHT_INJECT=<golden-bad> folds a known-broken
+    fixture's report into the gate — the tier-1 proof that the refusal
+    path actually fires without needing a dirty working tree."""
     if os.environ.get("CHARON_TPU_PREFLIGHT", "1") == "0":
         return
     from charon_tpu.analysis.audit import run_audit
@@ -63,6 +69,13 @@ def _preflight_audit(v: int, t: int) -> None:
     report = run_audit(shapes=[(v, t)], trace=trace, shard=False)
     violations = list(report.violations)
     summaries = [report.summary()]
+    inject = os.environ.get("CHARON_TPU_PREFLIGHT_INJECT")
+    if inject:
+        from charon_tpu.analysis.fixtures import audit_golden_bad
+
+        injected = audit_golden_bad(inject)
+        violations += injected.violations
+        summaries.append(f"[inject {inject}] {injected.summary()}")
     pairing_note = "pairing path inactive (arith-only)"
     # trace the pairing family only when the fused verify path would
     # actually serve this bench (TPU backend / forced on) — its grid

@@ -29,6 +29,24 @@ passes that need no TPU attached:
    (``inc``/``set_gauge``/``observe``) must pass a snake_case string
    literal with a ``charon_tpu_``/``core_``/``app_`` prefix, one metric
    type per name, no histogram-expansion collisions.
+5. **Lock discipline** (`concurrency`): every class that shares mutable
+   state between the event loop and the dispatch/serving worker threads
+   declares its guarded attributes + owning lock in a
+   ``SharedStateSpec``; the pass walks the AST and rejects any
+   read-modify-write of a guarded attribute outside a ``with <lock>``
+   block (or a ``*_locked`` helper), plus any lock-ordering cycle in the
+   static with-nesting graph.
+6. **Event-loop discipline** (`asyncio_lint`): no blocking call
+   (``time.sleep``, sync file I/O, inline ``tbls`` crypto) inside an
+   ``async def``, device entry points stay behind the
+   ``assert_off_loop`` taint closure, no deprecated
+   ``asyncio.get_event_loop``, no fire-and-forget ``create_task``, and
+   no ``asyncio.wait_for`` wrapping a bare ``.get()`` (the round-8
+   silent-timeout footgun).
+
+The static concurrency passes have a runtime twin in
+``charon_tpu/testutil/racecheck.py`` — a deterministic, seeded stress
+harness with instrumented locks; see docs/analysis.md.
 
 Run it as ``python -m charon_tpu.analysis`` (exit 0 iff every contract
 holds), as a tier-1 test (tests/test_static_analysis.py), as the

@@ -27,7 +27,9 @@ def main(argv=None) -> int:
     ap.add_argument("--golden-bad",
                     choices=["r05_vmem", "replicated_carry", "float_leak",
                              "bad_buckets", "unbounded_label",
-                             "undocumented_metric", "resident_roundtrip"],
+                             "undocumented_metric", "resident_roundtrip",
+                             "unguarded_mutation", "lock_cycle",
+                             "blocking_in_async", "waitfor_swallow"],
                     help="audit a known-broken fixture instead of HEAD "
                          "(expected exit status: non-zero)")
     ap.add_argument("--trace", default="all",
@@ -39,6 +41,10 @@ def main(argv=None) -> int:
                     help="skip the shard-carry pass")
     ap.add_argument("--no-metrics-lint", action="store_true",
                     help="skip the metric-name lint pass")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the lock-discipline pass")
+    ap.add_argument("--no-asyncio-lint", action="store_true",
+                    help="skip the event-loop-discipline pass")
     ap.add_argument("--shapes", default=None,
                     help="comma-separated VxT list overriding the "
                          "registered workload shapes, e.g. 10000x7,1024x2")
@@ -84,7 +90,9 @@ def main(argv=None) -> int:
                       for part in args.shapes.split(",")]
         report = run_audit(shapes=shapes, trace=args.trace,
                            shard=not args.no_shard, n_dev=args.devices,
-                           metrics=not args.no_metrics_lint)
+                           metrics=not args.no_metrics_lint,
+                           concurrency=not args.no_concurrency,
+                           asyncio_lint=not args.no_asyncio_lint)
 
     if args.json:
         # stdout stays parseable JSON; the human summary goes to stderr

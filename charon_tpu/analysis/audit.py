@@ -72,6 +72,8 @@ class AuditReport:
     residency_cases: list = field(default_factory=list)
     shapes_checked: list = field(default_factory=list)
     metrics_lint: object = None  # metrics_lint.MetricsLintReport | None
+    concurrency: object = None   # concurrency.ConcurrencyReport | None
+    asyncio_lint: object = None  # asyncio_lint.AsyncLintReport | None
 
     @property
     def violations(self) -> list:
@@ -82,8 +84,10 @@ class AuditReport:
             out += s.violations
         for r in self.residency_cases:
             out += r.violations
-        if self.metrics_lint is not None:
-            out += self.metrics_lint.violations
+        for lint in (self.metrics_lint, self.concurrency,
+                     self.asyncio_lint):
+            if lint is not None:
+                out += lint.violations
         return out
 
     @property
@@ -99,6 +103,10 @@ class AuditReport:
             "residency_cases": [asdict(r) for r in self.residency_cases],
             "metrics_lint": (self.metrics_lint.to_dict()
                              if self.metrics_lint is not None else None),
+            "concurrency": (self.concurrency.to_dict()
+                            if self.concurrency is not None else None),
+            "asyncio_lint": (self.asyncio_lint.to_dict()
+                             if self.asyncio_lint is not None else None),
             "violations": self.violations,
         }
 
@@ -127,8 +135,10 @@ class AuditReport:
                       if r.eqns is not None else "trace failed")
             lines.append(f"  [{verdict}] {r.name}: resident end-to-end "
                          f"({traced}, {len(r.stages)} stages)")
-        if self.metrics_lint is not None:
-            lines.append(self.metrics_lint.summary())
+        for lint in (self.metrics_lint, self.concurrency,
+                     self.asyncio_lint):
+            if lint is not None:
+                lines.append(lint.summary())
         for v in self.violations:
             lines.append(f"  VIOLATION: {v}")
         status = "PASS" if self.ok else "FAIL"
@@ -282,6 +292,8 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
               n_dev: int | None = None, tolerance=None,
               shard_retrace: bool = True,
               metrics: bool = True,
+              concurrency: bool = True,
+              asyncio_lint: bool = True,
               residency: bool | None = None) -> AuditReport:
     """Run the kernel contract audit.
 
@@ -296,6 +308,11 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
              checking on (see shard_audit.audit_shard_case).
     metrics : run the metric-name lint over the package source (pure
              AST, sub-second — on in every audit surface).
+    concurrency : run the lock-discipline pass (SharedStateSpec guarded
+             attributes + static lock-order graph) over the package
+             source.  Pure AST, on everywhere like the metrics lint.
+    asyncio_lint : run the event-loop-discipline pass over every
+             ``async def`` in the package.  Pure AST, on everywhere.
     residency : run the residency pass over the registered fused
              dispatch graphs (each graph traces once, seconds under the
              DIRECT forms).  Default: on when the verify-path kernels
@@ -309,6 +326,14 @@ def run_audit(shapes=None, trace: str = "all", shard: bool = True,
         from .metrics_lint import lint_package
 
         report.metrics_lint = lint_package()
+    if concurrency:
+        from .concurrency import check_package
+
+        report.concurrency = check_package()
+    if asyncio_lint:
+        from .asyncio_lint import lint_package as lint_async_package
+
+        report.asyncio_lint = lint_async_package()
 
     s_rows_map = _shape_s_rows("g2", shapes)
     pairing_map = _shape_s_rows("pairing", shapes)

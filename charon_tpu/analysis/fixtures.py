@@ -204,6 +204,91 @@ Alert expr: histogram_quantile(0.99,
 '''
 
 
+#: Concurrency-pass golden-bad: a guarded counter rebound OUTSIDE its
+#: declared lock — the round-13 pipeline-counter bug class in miniature.
+UNGUARDED_MUTATION_SRC = '''\
+import threading
+
+
+class FixturePipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.launches = 0
+        self.queue_depth = 0
+
+    def record(self):
+        with self._lock:
+            self.queue_depth += 1
+        self.launches += 1
+'''
+
+#: Lock-ordering golden-bad: two module locks taken in opposite orders
+#: at two sites — the static graph must reject the cycle (potential
+#: deadlock) without ever running the code.
+LOCK_CYCLE_SRC = '''\
+import threading
+
+_CACHE_LOCK = threading.Lock()
+_STATS_LOCK = threading.Lock()
+
+
+def commit():
+    with _CACHE_LOCK:
+        with _STATS_LOCK:
+            pass
+
+
+def snapshot():
+    with _STATS_LOCK:
+        with _CACHE_LOCK:
+            pass
+'''
+
+#: Asyncio-lint golden-bads: a sync sleep on the loop, and the exact
+#: round-8 footgun shape (`asyncio.wait_for` wrapping a bare queue get —
+#: on timeout the cancellation can swallow an already-dequeued item).
+BLOCKING_IN_ASYNC_SRC = '''\
+import time
+
+
+async def refresh():
+    time.sleep(0.5)
+    return True
+'''
+
+WAITFOR_SWALLOW_SRC = '''\
+import asyncio
+
+
+async def consume(queue):
+    return await asyncio.wait_for(queue.get(), timeout=1.0)
+'''
+
+
+def concurrency_golden_bad(which: str):
+    """Run the lock-discipline pass over one known-bad source fixture."""
+    from .concurrency import SharedStateSpec, check_sources
+
+    path = f"charon_tpu/golden_bad_{which}.py"
+    if which == "unguarded_mutation":
+        spec = SharedStateSpec(
+            file=path, scope="FixturePipeline", lock="_lock",
+            attrs=("launches", "queue_depth"))
+        return check_sources({path: UNGUARDED_MUTATION_SRC}, specs=(spec,))
+    if which == "lock_cycle":
+        return check_sources({path: LOCK_CYCLE_SRC}, specs=())
+    raise ValueError(f"unknown concurrency fixture {which!r}")
+
+
+def asyncio_golden_bad(which: str):
+    """Run the asyncio lint over one known-bad source fixture."""
+    from .asyncio_lint import lint_sources
+
+    src = {"blocking_in_async": BLOCKING_IN_ASYNC_SRC,
+           "waitfor_swallow": WAITFOR_SWALLOW_SRC}[which]
+    return lint_sources({f"charon_tpu/golden_bad_{which}.py": src})
+
+
 def resident_roundtrip_spec() -> registry.ResidencyProgramSpec:
     """The residency-pass golden-bad: a fused-graph builder that fetches
     an intermediate back to the host (``np.asarray`` on the traced
@@ -258,6 +343,14 @@ def audit_golden_bad(which: str):
         # pure-AST lint fixtures: no kernel registry (and no jax) needed
         report = AuditReport()
         report.metrics_lint = lint_golden_bad(which)
+        return report
+    if which in ("unguarded_mutation", "lock_cycle"):
+        report = AuditReport()
+        report.concurrency = concurrency_golden_bad(which)
+        return report
+    if which in ("blocking_in_async", "waitfor_swallow"):
+        report = AuditReport()
+        report.asyncio_lint = asyncio_golden_bad(which)
         return report
 
     registry.ensure_populated()

@@ -57,6 +57,14 @@ log = logging.getLogger(__name__)
 _CAP_PREFIX = "cap"
 
 
+def _write_capture_meta(cap_dir: str, meta: dict) -> None:
+    """Sync mkdir + meta.json write, run via asyncio.to_thread from
+    `trigger` (file I/O must not ride the already-SLO-breached loop)."""
+    os.makedirs(cap_dir, exist_ok=True)
+    with open(os.path.join(cap_dir, "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+
+
 def enabled(default_on: bool = True) -> bool:
     """CHARON_TPU_AUTOPROFILE: 1 force-on, 0 force-off, auto = caller's
     default (App: on; test simnet Node: off)."""
@@ -152,12 +160,14 @@ class AutoProfiler:
         cap_dir = os.path.join(
             self.out_dir, f"{_CAP_PREFIX}{self._seq:04d}-{reason}")
         try:
-            os.makedirs(cap_dir, exist_ok=True)
             meta = {"reason": reason, "trace_id": trace_id,
                     "detail": detail, "seconds": self.seconds,
                     "unix_time": time.time()}
-            with open(os.path.join(cap_dir, "meta.json"), "w") as fh:
-                json.dump(meta, fh)
+            # mkdir + meta write off-loop: the trigger fires exactly when
+            # the loop is already missing its SLO, so even a one-syscall
+            # stall on a slow/networked profile dir is the wrong place
+            # to spend loop time
+            await asyncio.to_thread(_write_capture_meta, cap_dir, meta)
             if self._capture_fn is not None:
                 self._capture_fn(cap_dir)
             else:
@@ -165,7 +175,8 @@ class AutoProfiler:
         except Exception:  # noqa: BLE001 — a watchdog must never crash
             self.capture_errors += 1
             log.exception("auto-profile capture failed (%s)", reason)
-            shutil.rmtree(cap_dir, ignore_errors=True)
+            await asyncio.to_thread(shutil.rmtree, cap_dir,
+                                    ignore_errors=True)
             return None
         finally:
             monitoring.profile_guard_release()

@@ -18,7 +18,7 @@ async def forkjoin(inputs: Iterable[T], fn: Callable[[T], Awaitable[R]],
                    fail_fast: bool = True) -> list[R]:
     """Apply fn to all inputs concurrently.  fail_fast cancels siblings on
     the first exception (reference forkjoin's default)."""
-    tasks = [asyncio.get_event_loop().create_task(fn(x)) for x in inputs]
+    tasks = [asyncio.get_running_loop().create_task(fn(x)) for x in inputs]
     if fail_fast:
         try:
             return list(await asyncio.gather(*tasks))
@@ -37,7 +37,7 @@ async def first_success(fns: list[Callable[[], Awaitable[R]]],
     (reference: eth2wrap.go:161-218 provide/firstSuccess)."""
     if not fns:
         raise ValueError("no functions provided")
-    tasks = [asyncio.get_event_loop().create_task(fn()) for fn in fns]
+    tasks = [asyncio.get_running_loop().create_task(fn()) for fn in fns]
     last_exc: BaseException | None = None
     pending = set(tasks)
     try:
@@ -49,6 +49,7 @@ async def first_success(fns: list[Callable[[], Awaitable[R]]],
                 raise asyncio.TimeoutError("first_success timed out")
             for t in done:
                 if t.exception() is None:
+                    # async-ok: completed-task read (t is in the done set)
                     return t.result()
                 last_exc = t.exception()
         raise last_exc  # all failed

@@ -70,7 +70,7 @@ class Manager:
         for hook in sorted(self._start_hooks, key=lambda h: h.order):
             if hook.background:
                 self._tasks.append(
-                    asyncio.get_event_loop().create_task(hook.fn(),
+                    asyncio.get_running_loop().create_task(hook.fn(),
                                                          name=hook.name))
             else:
                 await hook.fn()

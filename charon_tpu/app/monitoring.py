@@ -630,4 +630,5 @@ class MonitoringAPI:
         finally:
             profile_guard_release()
             if tmp is not None:
-                shutil.rmtree(tmp, ignore_errors=True)
+                await asyncio.to_thread(shutil.rmtree, tmp,
+                                        ignore_errors=True)

@@ -77,7 +77,7 @@ class PeerInfo:
             while True:
                 await self.poll_once()
                 await asyncio.sleep(self.interval)
-        self._task = asyncio.get_event_loop().create_task(loop())
+        self._task = asyncio.get_running_loop().create_task(loop())
 
     def stop(self) -> None:
         if self._task is not None:

@@ -46,7 +46,7 @@ class Retryer:
               fn: Callable[[], Awaitable]) -> None:
         """Run fn with retries in the background (the async part of the
         reference's WithAsyncRetry)."""
-        task = asyncio.get_event_loop().create_task(
+        task = asyncio.get_running_loop().create_task(
             self._retry(name, duty, fn), name=name)
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)

@@ -142,7 +142,7 @@ class SingleFlightCache:
                                labels={"endpoint": endpoint})
         if deadline is None and ttl is not None:
             deadline = self._clock() + ttl
-        task = asyncio.get_event_loop().create_task(
+        task = asyncio.get_running_loop().create_task(
             self._fill(k, fetch, deadline, cache_if))
         self._inflight[k] = task
         return await asyncio.shield(task)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextvars
 import hashlib
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -58,13 +59,23 @@ class Tracer:
     full ring never loses exports — only the in-memory view rolls over.
     Each eviction increments `dropped` (exported to the registry as
     ``charon_tpu_tracer_dropped_spans_total``), replacing the old
-    silent drop-newest-forever behaviour."""
+    silent drop-newest-forever behaviour.
+
+    Spans start and end on the prep/launch/prewarm threads (the
+    `device_span` hooks) as well as the event loop, so the ring, the
+    sequence counter and the drop/sink-error counters are cross-thread
+    state: `_lock` guards them (declared in the analysis
+    `SharedStateSpec` registry and enforced by the lock-discipline
+    pass).  Registry calls happen OUTSIDE the lock — the Registry has
+    its own lock and nesting them would put a Tracer→Registry edge in
+    the static lock-order graph for no benefit."""
 
     def __init__(self, registry=None, max_spans: int = 16384):
         self.spans: deque[Span] = deque(maxlen=max_spans)
         self._registry = registry
         self._max = max_spans
         self._seq = 0
+        self._lock = threading.Lock()
         self._sinks: list = []
         self.dropped = 0
         self.sink_errors = 0
@@ -76,22 +87,25 @@ class Tracer:
     def start_span(self, name: str, trace_id: str | None = None,
                    **attrs) -> "SpanHandle":
         parent: Span | None = _current_span.get()
-        if trace_id is None:
-            trace_id = (parent.trace_id if parent is not None
-                        else hashlib.sha256(
-                            f"root{self._seq}".encode()).hexdigest()[:32])
-        self._seq += 1
-        span = Span(trace_id=trace_id,
-                    span_id=f"{self._seq:016x}",
-                    name=name,
-                    parent_id=parent.span_id if parent is not None else None,
-                    start=time.time(), attrs=dict(attrs))
-        if len(self.spans) == self._max:
-            # deque(maxlen) evicts the oldest span on append
-            self.dropped += 1
-            if self._registry is not None:
-                self._registry.inc("charon_tpu_tracer_dropped_spans_total")
-        self.spans.append(span)
+        with self._lock:
+            if trace_id is None:
+                trace_id = (parent.trace_id if parent is not None
+                            else hashlib.sha256(
+                                f"root{self._seq}".encode()).hexdigest()[:32])
+            self._seq += 1
+            span = Span(trace_id=trace_id,
+                        span_id=f"{self._seq:016x}",
+                        name=name,
+                        parent_id=(parent.span_id if parent is not None
+                                   else None),
+                        start=time.time(), attrs=dict(attrs))
+            evicting = len(self.spans) == self._max
+            if evicting:
+                # deque(maxlen) evicts the oldest span on append
+                self.dropped += 1
+            self.spans.append(span)
+        if evicting and self._registry is not None:
+            self._registry.inc("charon_tpu_tracer_dropped_spans_total")
         return SpanHandle(self, span)
 
     def _finish(self, span: Span) -> None:
@@ -114,8 +128,10 @@ class Tracer:
                 self._note_sink_error()
 
     def _note_sink_error(self) -> None:
-        self.sink_errors += 1
-        if self.sink_errors == 1:
+        with self._lock:
+            self.sink_errors += 1
+            first = self.sink_errors == 1
+        if first:
             import logging
 
             logging.getLogger(__name__).exception(

@@ -203,7 +203,7 @@ def _cmd_run(args) -> int:
         app = App(cfg)
         import signal
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             try:
                 loop.add_signal_handler(sig, app.stop)
@@ -234,6 +234,7 @@ def _cmd_dkg(args) -> int:
             from .cluster.definition import verify_definition_signatures
 
             verify_definition_signatures(definition)
+        # async-ok: boot-time one-shot read, before the mesh starts
         with open(args.identity_key_file) as f:
             identity = ident.NodeIdentity.from_bytes(
                 bytes.fromhex(f.read().strip()))

@@ -42,7 +42,7 @@ class MemAggSigDB:
         key = (duty, pubkey)
         if key in self._data:
             return self._data[key]
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._waiters[key].append(fut)
         return await fut
 

@@ -189,7 +189,7 @@ class QBFTConsensus:
             await self._net.broadcast(duty, msg)
 
         t = qbft.Transport(bcast, q)
-        task = asyncio.get_event_loop().create_task(
+        task = asyncio.get_running_loop().create_task(
             qbft.run(self._definition(duty), t, duty, self._peer_idx,
                      lambda: self._inputs.get(duty)))
 

@@ -57,7 +57,7 @@ class Deadliner:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.get_event_loop().create_task(self._run())
+            self._task = asyncio.get_running_loop().create_task(self._run())
 
     def add(self, duty: Duty) -> bool:
         """Register a duty; returns False iff its deadline already passed.

@@ -132,7 +132,7 @@ class MemDutyDB:
         ud = self._att_by_key.get(key)
         if ud is not None:
             return ud.data
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._att_waiters[key].append(fut)
         return await fut
 
@@ -140,7 +140,7 @@ class MemDutyDB:
         ud = self._block_by_slot.get(slot)
         if ud is not None:
             return ud.block
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._block_waiters[slot].append(fut)
         return await fut
 
@@ -149,7 +149,7 @@ class MemDutyDB:
         ud = self._agg_att.get(key)
         if ud is not None:
             return ud.attestation
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._agg_waiters[key].append(fut)
         return await fut
 
@@ -159,7 +159,7 @@ class MemDutyDB:
         ud = self._contrib.get(key)
         if ud is not None:
             return ud.contribution
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._contrib_waiters[key].append(fut)
         return await fut
 

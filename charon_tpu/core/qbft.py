@@ -174,10 +174,10 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
             round_ = new_round
             dedup = {}
 
-    timer_deadline = [asyncio.get_event_loop().time() + d.round_timeout(round_)]
+    timer_deadline = [asyncio.get_running_loop().time() + d.round_timeout(round_)]
 
     def reset_timer() -> None:
-        timer_deadline[0] = (asyncio.get_event_loop().time()
+        timer_deadline[0] = (asyncio.get_running_loop().time()
                              + d.round_timeout(round_))
 
     # Algorithm 1:11 — leader proposes in round 1.
@@ -198,7 +198,7 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
         while True:
             timeout = (None if decided_evt.is_set()
                        else max(0.0, timer_deadline[0]
-                                - asyncio.get_event_loop().time()))
+                                - asyncio.get_running_loop().time()))
             if getter is None:
                 getter = asyncio.ensure_future(t.receive.get())
             done, _ = await asyncio.wait({getter}, timeout=timeout)
@@ -211,6 +211,7 @@ async def run(d: Definition, t: Transport, instance: Any, process: int,
                               UponRule.ROUND_TIMEOUT)
                 await broadcast_round_change()
                 continue
+            # async-ok: completed-task read (getter is in the done set)
             msg = getter.result()
             getter = None
 

@@ -83,7 +83,7 @@ class Scheduler:
             return dict(self._defs[duty])
         if duty.slot // spe in self._resolved_epochs:
             return {}  # epoch resolved, no such duty
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._def_waiters[duty].append(fut)
         return await fut
 
@@ -215,7 +215,7 @@ class Scheduler:
                 continue
             offset = DUTY_OFFSETS.get(duty.type, 0.0)
             fire_at = tick.time + offset * tick.slot_duration
-            self._tasks.append(asyncio.get_event_loop().create_task(
+            self._tasks.append(asyncio.get_running_loop().create_task(
                 self._fire(duty, dict(defset), fire_at)))
 
     async def _fire(self, duty: Duty, defset: DutyDefinitionSet,

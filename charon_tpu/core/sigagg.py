@@ -28,6 +28,7 @@ from dataclasses import dataclass
 
 from ..tbls import api as tbls
 from ..tbls import dispatch
+from . import background
 from .types import Duty, ParSignedData, PubKey
 
 
@@ -71,7 +72,7 @@ class SigAgg:
         # one to wake drains the whole queue and the rest no-op.  (A shared
         # "is a flusher running" flag would race: entries enqueued while a
         # flusher is mid-combine would never be picked up.)
-        loop.create_task(self._flush())
+        background.spawn(self._flush(), name="sigagg-flush")
         await fut
 
     async def _flush(self) -> None:
@@ -101,7 +102,8 @@ class SigAgg:
         stage_stats: dict = {}
         try:
             with span as sp:
-                if pipe is None:    # CHARON_TPU_DISPATCH=0: legacy inline
+                if pipe is None:
+                    # async-ok: legacy inline path, CHARON_TPU_DISPATCH=0
                     combined = tbls.threshold_combine(sig_sets)
                 else:
                     # ONE coalesced launch, awaited off-loop

@@ -54,6 +54,7 @@ from dataclasses import dataclass, field
 
 from ..tbls import api as tbls
 from ..tbls import dispatch
+from . import background
 
 
 @dataclass
@@ -118,7 +119,7 @@ class BatchVerifier:
         # see `_draining` and no-op.  The drainer clears the flag with
         # no await after its final empty-queue check, so nothing can be
         # stranded between drainer exit and the next flusher task.
-        loop.create_task(self._flush())
+        background.spawn(self._flush(), name="batch-verify-flush")
         return await item.done
 
     async def _flush(self) -> None:
@@ -179,7 +180,8 @@ class BatchVerifier:
         try:
             with span as sp:
                 t0 = time.perf_counter()
-                if pipe is None:    # CHARON_TPU_DISPATCH=0: legacy inline
+                if pipe is None:
+                    # async-ok: legacy inline path, CHARON_TPU_DISPATCH=0
                     oks = tbls.batch_verify(flat)
                 else:
                     # ONE coalesced launch unit, awaited off-loop (tiled

@@ -251,6 +251,7 @@ class Ceremony:
 
         async def verify_batch(entries):
             return (await pipe.batch_verify(entries) if pipe is not None
+                    # async-ok: legacy inline path, CHARON_TPU_DISPATCH=0
                     else tbls.batch_verify(entries))
 
         rows = []       # (r, kind, root) aligned with the combine batch
@@ -274,6 +275,7 @@ class Ceremony:
         batch = [dict(list(p.items())[: self.t]) for p in row_partials]
         combined = (await pipe.threshold_combine(batch)
                     if pipe is not None
+                    # async-ok: legacy inline path, CHARON_TPU_DISPATCH=0
                     else tbls.threshold_combine(batch))
         group_entries = [(r.group_pubkey, root, sig)
                          for (r, kind, root), sig in zip(rows, combined)]
@@ -313,11 +315,16 @@ async def run_dkg(definition: Definition, mesh: TCPMesh, index: int,
     lock, deposits = await cer.sign_and_aggregate(results, creds)
     fork = definition.fork_version
 
-    os.makedirs(output_dir, exist_ok=True)
-    keystore.store_keys([r.secret_share for r in results],
-                        os.path.join(output_dir, "validator_keys"))
-    save_json(os.path.join(output_dir, "cluster-lock.json"),
-              lock_to_json(lock))
-    deposit_mod.save_deposit_data(
-        os.path.join(output_dir, "deposit-data.json"), deposits, fork)
+    def write_outputs() -> None:
+        os.makedirs(output_dir, exist_ok=True)
+        keystore.store_keys([r.secret_share for r in results],
+                            os.path.join(output_dir, "validator_keys"))
+        save_json(os.path.join(output_dir, "cluster-lock.json"),
+                  lock_to_json(lock))
+        deposit_mod.save_deposit_data(
+            os.path.join(output_dir, "deposit-data.json"), deposits, fork)
+
+    # key material hits disk off-loop: the mesh handlers of peers still
+    # finishing their ceremony are served by THIS loop
+    await asyncio.to_thread(write_outputs)
     return lock

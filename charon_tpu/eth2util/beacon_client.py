@@ -305,6 +305,7 @@ class MultiBeaconClient:
                     pending, return_when=asyncio.FIRST_COMPLETED)
                 for t in done:
                     if t.exception() is None:
+                        # async-ok: completed-task read (t is in the done set)
                         return t.result()
                     last_err = t.exception()
             raise last_err or RuntimeError("all beacon nodes failed")

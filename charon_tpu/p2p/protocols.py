@@ -168,7 +168,13 @@ class P2PConsensusTransport:
         duty, msg = serialize.decode_consensus_msg(payload)
         if msg.source != sender:
             return None  # spoofed source: drop
-        if not verify_consensus_msg(msg, self._mesh.peer_pubkeys):
+        # signature + recursive justification checks are device-backed
+        # pairings on the TPU backend: run them off-loop so a burst of
+        # inbound frames cannot stall QBFT timers (the loop guard rejects
+        # the inline form)
+        ok = await asyncio.to_thread(verify_consensus_msg, msg,
+                                     self._mesh.peer_pubkeys)
+        if not ok:
             return None  # forged message or justification: drop
         if self._node is not None:
             await self._node._deliver(duty, msg)

@@ -285,7 +285,7 @@ class TCPMesh:
                            payload: bytes, timeout: float = 5.0) -> bytes:
         """Synchronous request/response over the mesh."""
         msg_id = self._next_id()
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
         try:
             await self._send_frame(peer_index, protocol, payload,
@@ -302,9 +302,9 @@ class TCPMesh:
     # -- ping (reference: p2p/ping.go) --------------------------------------
 
     async def ping(self, peer_index: int) -> float:
-        t0 = asyncio.get_event_loop().time()
+        t0 = asyncio.get_running_loop().time()
         await self.send_receive(peer_index, "/charon_tpu/ping/1.0.0", b"ping")
-        rtt = asyncio.get_event_loop().time() - t0
+        rtt = asyncio.get_running_loop().time() - t0
         self.rtts[peer_index] = rtt
         return rtt
 
@@ -374,7 +374,7 @@ class TCPMesh:
             ch = self._channels.get(peer_index)
             if ch is not None and not ch.writer.is_closing():
                 return ch
-            now = asyncio.get_event_loop().time()
+            now = asyncio.get_running_loop().time()
             state = self._backoff.get(peer_index)
             if state is not None and now < state[0]:
                 # gate closed: fail fast, do NOT redial (see class doc)
@@ -410,7 +410,7 @@ class TCPMesh:
                 # dropped SYNs, handshake timeout) would otherwise leave
                 # the gate pre-expired and the storm protection inert
                 self._backoff[peer_index] = (
-                    asyncio.get_event_loop().time() + next(delays), delays)
+                    asyncio.get_running_loop().time() + next(delays), delays)
                 raise ConnectionError(f"connect to {peer_index}: {e}")
             self._backoff.pop(peer_index, None)
             if self.registry is not None:
@@ -419,7 +419,7 @@ class TCPMesh:
                                       labels={"peer": str(peer_index)})
                 self._ever_connected.add(peer_index)
             self._channels[peer_index] = ch
-            self._tasks.append(asyncio.get_event_loop().create_task(
+            self._tasks.append(asyncio.get_running_loop().create_task(
                 self._read_loop(ch)))
             return ch
 
@@ -432,7 +432,7 @@ class TCPMesh:
 
     async def _send_frame(self, peer_index: int, protocol: str,
                           payload: bytes, msg_id: int, is_reply: bool):
-        t0 = asyncio.get_event_loop().time()
+        t0 = asyncio.get_running_loop().time()
         ch = await self._connect(peer_index)
         if self._faults is not None:
             await self._faults.on_send(peer_index, protocol, len(payload))
@@ -443,7 +443,7 @@ class TCPMesh:
         # latency covers connect (incl. handshake on a cold channel) +
         # seal + kernel hand-off — the sender-side slot-budget cost
         self._count_sent(peer_index, len(frame),
-                         asyncio.get_event_loop().time() - t0)
+                         asyncio.get_running_loop().time() - t0)
 
     async def _on_inbound(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -523,13 +523,13 @@ class TCPMesh:
             return
         reply = await handler(sender, payload)
         if reply is not None:
-            t0 = asyncio.get_event_loop().time()
+            t0 = asyncio.get_running_loop().time()
             frame = ch.seal(self._encode_body(protocol, reply, msg_id,
                                               is_reply=True))
             ch.writer.write(frame)
             await ch.writer.drain()
             self._count_sent(ch.peer_index, len(frame),
-                             asyncio.get_event_loop().time() - t0)
+                             asyncio.get_running_loop().time() - t0)
 
 
 def mesh_params_from_definition(definition) -> tuple[list[Peer],

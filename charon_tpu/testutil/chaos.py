@@ -345,7 +345,7 @@ class ChaosRouter:
         self._tasks: set = set()
 
     def slot_now(self) -> int:
-        now = asyncio.get_event_loop().time()
+        now = asyncio.get_running_loop().time()
         return max(0, int(now // self.slot_duration))
 
     async def route(self, frm: int, to: int, proto: str, deliver) -> None:
@@ -359,7 +359,7 @@ class ChaosRouter:
             return
         if delay > 0.0:
             self.delayed += 1
-            task = asyncio.get_event_loop().create_task(
+            task = asyncio.get_running_loop().create_task(
                 self._deliver_later(delay, to, deliver))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
@@ -566,7 +566,7 @@ class MeshLinkFaults:
         self._dur = slot_duration
 
     def _slot(self) -> int:
-        return max(0, int(asyncio.get_event_loop().time() // self._dur))
+        return max(0, int(asyncio.get_running_loop().time() // self._dur))
 
     async def on_dial(self, peer_index: int) -> None:
         ok, delay = link_gate(self._plan, self._rng, self._slot(),

@@ -44,9 +44,10 @@ class ValidatorMock:
         """Scheduler slot subscriber.  Spawns the duty flows as tasks so the
         scheduler tick never blocks on duty data becoming available
         (reference: app/vmock.go spawns goroutines per flow)."""
-        import asyncio
+        from ..core import background
 
-        asyncio.get_event_loop().create_task(self._run_slot(slot))
+        background.spawn(self._run_slot(slot),
+                         name=f"vmock-slot-{slot.slot}")
 
     async def _run_slot(self, slot: SlotTick) -> None:
         try:
@@ -137,7 +138,7 @@ class ValidatorMock:
         sel_sig = self._sign(group_pk,
                              DomainName.SYNC_COMMITTEE_SELECTION_PROOF,
                              sel_root, slot.epoch)
-        selection_task = asyncio.get_event_loop().create_task(
+        selection_task = asyncio.get_running_loop().create_task(
             self._vapi.submit_sync_committee_selections(
                 [sel.replace(selection_proof=sel_sig)]))
         # 2. sync-committee message over the block root

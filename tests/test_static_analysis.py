@@ -412,3 +412,122 @@ def test_float_dtype_screen_matches_jax():
     assert not any(d.startswith("float") or d.startswith("complex")
                    for d in ALLOWED_KERNEL_DTYPES)
     assert str(jnp.zeros((1,), jnp.int32).dtype) in ALLOWED_KERNEL_DTYPES
+
+
+# ---------------------------------------------------------------------------
+# Concurrency contract passes (lock discipline + asyncio lint)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_discipline_clean_at_head():
+    """Every read-modify-write of a SharedStateSpec-guarded attribute is
+    inside its owning lock (or a *_locked helper), no undeclared locks,
+    no lock-order cycle — and the spec registry actually covers the
+    classes the dispatch/serving race fixes live in."""
+    from charon_tpu.analysis.concurrency import (SHARED_STATE_SPECS,
+                                                 check_package)
+
+    report = check_package()
+    assert report.ok, "\n".join(report.violations)
+    assert report.specs_checked == len(SHARED_STATE_SPECS) >= 13
+    assert report.mutation_sites >= 70  # guarded writes actually found
+    scopes = {s.scope for s in SHARED_STATE_SPECS}
+    # the shared-state classes of PR 9 (pipeline), PR 12 (device cache),
+    # PR 13 (tracer/autoprofile) and the serving single-flight cache
+    assert {"DispatchPipeline", "DeviceRowCache", "Registry", "Tracer",
+            "SingleFlightCache", "AutoProfiler"} <= scopes
+
+
+def test_asyncio_lint_clean_at_head():
+    """No blocking call in an async def, device entry points stay
+    behind the assert_off_loop taint closure, no deprecated
+    get_event_loop, no fire-and-forget create_task."""
+    from charon_tpu.analysis.asyncio_lint import lint_package
+
+    report = lint_package()
+    assert report.ok, "\n".join(report.violations)
+    assert report.async_defs > 200
+    # the PR 9 off-loop guard closure reaches the device entry points
+    assert {"batch_verify", "threshold_combine", "prewarm",
+            "verify"} <= set(report.tainted)
+    # every waiver carries a reason string
+    assert all(w for w in report.waived)
+
+
+def test_golden_bad_unguarded_mutation_flagged():
+    """A guarded-attribute write outside the owning lock names the
+    attribute, the site, and the lock that should have been held."""
+    report = audit_golden_bad("unguarded_mutation")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert ("unguarded mutation of FixturePipeline.launches "
+            "— declared guarded by '_lock'") in text
+    assert "golden_bad_unguarded_mutation.py:13" in text
+
+
+def test_golden_bad_lock_cycle_flagged():
+    """A with-nesting cycle between two module locks is reported as a
+    potential deadlock, naming the cycle and both nesting sites."""
+    report = audit_golden_bad("lock_cycle")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "lock-order cycle (potential deadlock)" in text
+    assert "_CACHE_LOCK -> " in text and "_STATS_LOCK -> " in text
+    assert "with-nesting sites at lines [9, 15]" in text
+
+
+def test_golden_bad_blocking_in_async_flagged():
+    report = audit_golden_bad("blocking_in_async")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "blocking call time.sleep() in an async def" in text
+
+
+def test_golden_bad_waitfor_swallow_flagged():
+    """The PR 8 exporter footgun: wait_for around a bare queue .get()
+    drops the item inside the cancelled task on timeout."""
+    report = audit_golden_bad("waitfor_swallow")
+    assert not report.ok
+    text = "\n".join(report.violations)
+    assert "asyncio.wait_for wrapping a bare .get()" in text
+
+
+def test_cli_golden_bad_concurrency_exits_nonzero():
+    """Driver-level contract for all four concurrency fixtures: the
+    real CLI exits 1 (and they are cheap — no kernel tracing)."""
+    for which in ("unguarded_mutation", "lock_cycle",
+                  "blocking_in_async", "waitfor_swallow"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "charon_tpu.analysis",
+             "--golden-bad", which],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "FAIL" in proc.stdout
+
+
+def test_concurrency_cli_flags():
+    """--no-concurrency / --no-asyncio-lint are accepted and the
+    default cheap-audit CLI path includes both passes."""
+    from charon_tpu.analysis.__main__ import main as analysis_main
+
+    assert analysis_main(["--trace", "none", "--no-shard",
+                          "--no-metrics-lint"]) == 0
+    assert analysis_main(["--trace", "none", "--no-shard",
+                          "--no-metrics-lint", "--no-concurrency",
+                          "--no-asyncio-lint"]) == 0
+
+
+def test_bench_preflight_refuses_injected_violation(monkeypatch):
+    """CHARON_TPU_PREFLIGHT_INJECT folds a golden-bad report into the
+    bench gate: the preflight must refuse (exit 2) without needing a
+    dirty working tree — and CHARON_TPU_PREFLIGHT=0 still skips
+    everything, injection included."""
+    import bench
+
+    monkeypatch.setenv("CHARON_TPU_PREFLIGHT_INJECT",
+                       "unguarded_mutation")
+    with pytest.raises(SystemExit) as exc:
+        bench._preflight_audit(1, 1)
+    assert exc.value.code == 2
+    monkeypatch.setenv("CHARON_TPU_PREFLIGHT", "0")
+    bench._preflight_audit(1, 1)  # skipped: must not raise / exit
